@@ -1,0 +1,1 @@
+from . import channels, hyperparameters, metrics  # noqa: F401

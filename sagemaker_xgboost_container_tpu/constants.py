@@ -1,0 +1,141 @@
+"""Shared constants: metric lists, objectives, content types, SM env names.
+
+Factual contract mirrored from the reference container's constants modules
+(`constants/xgb_constants.py:14-100`, `constants/sm_env_constants.py:16-38`,
+`constants/xgb_content_types.py:13-16`) — these names/strings are the API by
+which SageMaker, HPO, and customers observe the container, so they must match
+exactly even though the compute substrate underneath is JAX/XLA on TPU.
+"""
+
+# ---------------------------------------------------------------------------
+# Metric direction lists (drive HPO metric defs + early-stopping maximize set)
+# ---------------------------------------------------------------------------
+XGB_MAXIMIZE_METRICS = [
+    "accuracy",
+    "auc",
+    "aucpr",
+    "balanced_accuracy",
+    "f1",
+    "f1_binary",
+    "f1_macro",
+    "map",
+    "ndcg",
+    "precision",
+    "r2",
+    "recall",
+    "precision_macro",
+    "precision_micro",
+    "recall_macro",
+    "recall_micro",
+]
+
+XGB_MINIMIZE_METRICS = [
+    "aft-nloglik",
+    "cox-nloglik",
+    "error",
+    "gamma-deviance",
+    "gamma-nloglik",
+    "interval-regression-accuracy",
+    "logloss",
+    "mae",
+    "mape",
+    "merror",
+    "mlogloss",
+    "mphe",
+    "mse",
+    "poisson-nloglik",
+    "rmse",
+    "rmsle",
+    "tweedie-nloglik",
+]
+
+# ---------------------------------------------------------------------------
+# Error-message substrings that classify a training failure as customer-fixable
+# (reference: xgb_constants.py:53-77). Our booster raises UserError directly,
+# but the substring list is kept for remapping errors from loaded models/data.
+# ---------------------------------------------------------------------------
+LOGISTIC_REGRESSION_LABEL_RANGE_ERROR = "label must be in [0,1] for logistic regression"
+MULTI_CLASS_LABEL_RANGE_ERROR = "label must be in [0, num_class)"
+MULTI_CLASS_F1_BINARY_ERROR = "Target is multiclass but average='binary'"
+FEATURE_MISMATCH_ERROR = "feature_names mismatch"
+LABEL_PREDICTION_SIZE_MISMATCH = "Check failed: preds.size() == info.labels_.size()"
+ONLY_POS_OR_NEG_SAMPLES = "Check failed: !auc_error AUC: the dataset only contains pos or neg samples"
+BASE_SCORE_RANGE_ERROR = (
+    "Check failed: base_score > 0.0f && base_score < 1.0f base_score must be in (0,1) "
+    "for logistic loss"
+)
+POISSON_REGRESSION_ERROR = "Check failed: label_correct PoissonRegression: label must be nonnegative"
+TWEEDIE_REGRESSION_ERROR = "Check failed: label_correct TweedieRegression: label must be nonnegative"
+REG_LAMBDA_ERROR = "Parameter reg_lambda should be greater equal to 0"
+
+CUSTOMER_ERRORS = [
+    LOGISTIC_REGRESSION_LABEL_RANGE_ERROR,
+    MULTI_CLASS_LABEL_RANGE_ERROR,
+    MULTI_CLASS_F1_BINARY_ERROR,
+    FEATURE_MISMATCH_ERROR,
+    LABEL_PREDICTION_SIZE_MISMATCH,
+    ONLY_POS_OR_NEG_SAMPLES,
+    BASE_SCORE_RANGE_ERROR,
+    POISSON_REGRESSION_ERROR,
+    TWEEDIE_REGRESSION_ERROR,
+    REG_LAMBDA_ERROR,
+]
+
+# ---------------------------------------------------------------------------
+# Channels / objectives / model naming
+# ---------------------------------------------------------------------------
+TRAIN_CHANNEL = "train"
+VAL_CHANNEL = "validation"
+
+REG_SQUAREDERR = "reg:squarederror"
+REG_LOG = "reg:logistic"
+REG_GAMMA = "reg:gamma"
+REG_ABSOLUTEERR = "reg:absoluteerror"
+REG_TWEEDIE = "reg:tweedie"
+BINARY_LOG = "binary:logistic"
+BINARY_LOGRAW = "binary:logitraw"
+BINARY_HINGE = "binary:hinge"
+MULTI_SOFTMAX = "multi:softmax"
+MULTI_SOFTPROB = "multi:softprob"
+
+MODEL_NAME = "xgboost-model"
+
+FULLY_REPLICATED = "FullyReplicated"
+PIPE_MODE = "Pipe"
+
+# ---------------------------------------------------------------------------
+# Content types (xgb_content_types.py)
+# ---------------------------------------------------------------------------
+CSV = "text/csv"
+LIBSVM = "text/libsvm"
+X_LIBSVM = "text/x-libsvm"
+PARQUET = "application/x-parquet"
+X_PARQUET = "application/x-parquet"
+RECORDIO_PROTOBUF = "application/x-recordio-protobuf"
+X_RECORDIO_PROTOBUF = "application/x-recordio-protobuf"
+JSON = "application/json"
+JSONLINES = "application/jsonlines"
+
+# ---------------------------------------------------------------------------
+# SageMaker environment variable names (sm_env_constants.py)
+# ---------------------------------------------------------------------------
+SM_CURRENT_HOST = "SM_CURRENT_HOST"
+SM_HOSTS = "SM_HOSTS"
+SM_NUM_GPUS = "SM_NUM_GPUS"
+SM_NUM_TPUS = "SM_NUM_TPUS"
+
+SM_CHANNEL_TRAIN = "SM_CHANNEL_TRAIN"
+SM_CHANNEL_VALIDATION = "SM_CHANNEL_VALIDATION"
+SM_MODEL_DIR = "SM_MODEL_DIR"
+
+SM_INPUT_TRAINING_CONFIG_FILE = "SM_INPUT_TRAINING_CONFIG_FILE"
+SM_INPUT_DATA_CONFIG_FILE = "SM_INPUT_DATA_CONFIG_FILE"
+SM_CHECKPOINT_CONFIG_FILE = "SM_CHECKPOINT_CONFIG_FILE"
+SM_OUTPUT_DATA_DIR = "SM_OUTPUT_DATA_DIR"
+
+SAGEMAKER_INFERENCE_ENSEMBLE = "SAGEMAKER_INFERENCE_ENSEMBLE"
+SAGEMAKER_INFERENCE_OUTPUT = "SAGEMAKER_INFERENCE_OUTPUT"
+SAGEMAKER_DEFAULT_INVOCATIONS_ACCEPT = "SAGEMAKER_DEFAULT_INVOCATIONS_ACCEPT"
+SAGEMAKER_BATCH = "SAGEMAKER_BATCH"
+
+ONE_THREAD_PER_PROCESS = "1"

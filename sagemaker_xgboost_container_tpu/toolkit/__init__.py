@@ -1,0 +1,1 @@
+from . import channels, exceptions, hyperparameters, metrics  # noqa: F401

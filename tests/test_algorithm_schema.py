@@ -1,0 +1,228 @@
+"""Algorithm-mode schema tests: the XGBoost HP matrix, channels, HPO metrics.
+
+Mirrors the coverage of the reference's
+test/unit/algorithm_mode/test_algorithm_mode.py:34-187 (HP combinations,
+aliases, _kfold) plus the TPU-specific gpu_hist rejection.
+"""
+
+import pytest
+
+from sagemaker_xgboost_container_tpu.algorithm import channels as cv
+from sagemaker_xgboost_container_tpu.algorithm import hyperparameters as hpv
+from sagemaker_xgboost_container_tpu.algorithm import metrics as metrics_mod
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+
+@pytest.fixture(scope="module")
+def schema():
+    return hpv.initialize(metrics_mod.initialize())
+
+
+def test_minimal_valid(schema):
+    out = schema.validate({"num_round": "10"})
+    assert out["num_round"] == 10
+
+
+def test_full_typical_config(schema):
+    out = schema.validate(
+        {
+            "num_round": "100",
+            "eta": "0.1",
+            "max_depth": "6",
+            "objective": "binary:logistic",
+            "eval_metric": "auc,logloss",
+            "subsample": "0.8",
+            "lambda": "1.0",
+            "tree_method": "hist",
+            "early_stopping_rounds": "10",
+        }
+    )
+    assert out["eta"] == 0.1
+    assert out["eval_metric"] == ["auc", "logloss"]
+    assert out["tree_method"] == "hist"
+
+
+def test_num_round_required(schema):
+    with pytest.raises(exc.UserError, match="num_round"):
+        schema.validate({})
+
+
+def test_gpu_hist_rejected_with_clear_message(schema):
+    with pytest.raises(exc.UserError, match="TPU"):
+        schema.validate({"num_round": "5", "tree_method": "gpu_hist"})
+
+
+def test_gpu_predictor_rejected(schema):
+    with pytest.raises(exc.UserError, match="XLA forest kernel"):
+        schema.validate({"num_round": "5", "predictor": "gpu_predictor"})
+
+
+def test_aliases(schema):
+    out = schema.validate(
+        {
+            "num_round": "5",
+            "learning_rate": "0.2",
+            "min_split_loss": "1",
+            "reg_lambda": "2",
+            "reg_alpha": "3",
+        }
+    )
+    assert out["eta"] == 0.2
+    assert out["gamma"] == 1.0
+    assert out["lambda"] == 2.0
+    assert out["alpha"] == 3.0
+
+
+def test_multiclass_requires_num_class(schema):
+    with pytest.raises(exc.UserError, match="num_class"):
+        schema.validate({"num_round": "5", "objective": "multi:softmax"})
+    out = schema.validate(
+        {"num_round": "5", "objective": "multi:softmax", "num_class": "3"}
+    )
+    assert out["num_class"] == 3
+
+
+def test_num_class_without_objective_rejected(schema):
+    # matches reference semantics (hyperparameter_validation.py:82-90): the
+    # objective validator only runs when objective is supplied, and an explicit
+    # non-multi objective alongside num_class passes validation.
+    schema.validate({"num_round": "5", "num_class": "3"})
+    schema.validate(
+        {"num_round": "5", "objective": "reg:squarederror", "num_class": "3"}
+    )
+
+
+def test_flat_interaction_constraints_is_user_error(schema):
+    with pytest.raises(exc.UserError, match="could not parse"):
+        schema.validate(
+            {"num_round": "5", "tree_method": "hist", "interaction_constraints": "[1, 2]"}
+        )
+
+
+def test_auc_requires_classification(schema):
+    with pytest.raises(exc.UserError, match="auc"):
+        schema.validate(
+            {"num_round": "5", "objective": "reg:squarederror", "eval_metric": "auc"}
+        )
+    schema.validate(
+        {"num_round": "5", "objective": "binary:logistic", "eval_metric": "auc"}
+    )
+
+
+def test_eval_metric_with_threshold(schema):
+    schema.validate(
+        {"num_round": "5", "objective": "binary:logistic", "eval_metric": "error@0.7"}
+    )
+    with pytest.raises(exc.UserError):
+        schema.validate({"num_round": "5", "eval_metric": "rmse@0.7"})
+    with pytest.raises(exc.UserError):
+        schema.validate({"num_round": "5", "eval_metric": "error@abc"})
+
+
+def test_monotone_constraints_needs_hist_or_exact(schema):
+    schema.validate(
+        {"num_round": "5", "tree_method": "hist", "monotone_constraints": "(1, -1)"}
+    )
+    with pytest.raises(exc.UserError, match="monotone"):
+        schema.validate(
+            {"num_round": "5", "tree_method": "approx", "monotone_constraints": "(1)"}
+        )
+
+
+def test_interaction_constraints(schema):
+    out = schema.validate(
+        {
+            "num_round": "5",
+            "tree_method": "hist",
+            "interaction_constraints": "[[0, 1], [2, 3]]",
+        }
+    )
+    assert out["interaction_constraints"] == [[0, 1], [2, 3]]
+
+
+def test_updater_rules(schema):
+    schema.validate({"num_round": "5", "updater": "grow_histmaker,prune"})
+    with pytest.raises(exc.UserError, match="one tree grow plugin"):
+        schema.validate({"num_round": "5", "updater": "grow_histmaker,grow_colmaker"})
+    with pytest.raises(exc.UserError, match="Linear updater"):
+        schema.validate({"num_round": "5", "booster": "gblinear", "updater": "prune"})
+    schema.validate({"num_round": "5", "booster": "gblinear", "updater": "shotgun"})
+    with pytest.raises(exc.UserError, match="refresh"):
+        schema.validate(
+            {"num_round": "5", "process_type": "update", "updater": "grow_histmaker"}
+        )
+
+
+def test_kfold_internal_flags(schema):
+    out = schema.validate({"num_round": "5", "_kfold": "5", "_num_cv_round": "2"})
+    assert out["_kfold"] == 5 and out["_num_cv_round"] == 2
+    with pytest.raises(exc.UserError):
+        schema.validate({"num_round": "5", "_kfold": "1"})
+
+
+def test_tuning_objective_metric(schema):
+    out = schema.validate(
+        {"num_round": "5", "_tuning_objective_metric": "validation:rmse"}
+    )
+    assert out["_tuning_objective_metric"] == "validation:rmse"
+    with pytest.raises(exc.UserError):
+        schema.validate({"num_round": "5", "_tuning_objective_metric": "validation:zzz"})
+
+
+def test_channels_happy_path():
+    channels = cv.initialize()
+    validated = channels.validate(
+        {
+            "train": {
+                "ContentType": "text/csv",
+                "TrainingInputMode": "File",
+                "S3DistributionType": "FullyReplicated",
+            }
+        }
+    )
+    assert validated["train"]["ContentType"] == "text/csv"
+
+
+def test_channels_default_content_type():
+    channels = cv.initialize()
+    validated = channels.validate(
+        {
+            "train": {
+                "TrainingInputMode": "File",
+                "S3DistributionType": "ShardedByS3Key",
+            }
+        }
+    )
+    assert validated["train"]["ContentType"] == "text/libsvm"
+
+
+def test_channels_require_train():
+    channels = cv.initialize()
+    with pytest.raises(exc.UserError, match="train"):
+        channels.validate({})
+
+
+def test_channels_reject_pipe_mode():
+    channels = cv.initialize()
+    with pytest.raises(exc.UserError):
+        channels.validate(
+            {
+                "train": {
+                    "ContentType": "text/csv",
+                    "TrainingInputMode": "Pipe",
+                    "S3DistributionType": "FullyReplicated",
+                }
+            }
+        )
+
+
+def test_hpo_metric_regex_contract():
+    import re
+
+    metrics = metrics_mod.initialize()
+    rmse = metrics["validation:rmse"]
+    line = "[42]\ttrain-rmse:1.23\tvalidation-rmse:4.56".replace("\t", "#011")
+    match = re.match(rmse.regex, line)
+    assert match and match.group(1) == "4.56"
+    assert rmse.direction == "Minimize"
+    assert metrics["validation:auc"].direction == "Maximize"

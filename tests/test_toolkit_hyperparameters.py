@@ -1,0 +1,188 @@
+"""Toolkit validation-engine tests.
+
+Coverage model: the reference's engine tests
+(test/unit/algorithm_toolkit/test_hyperparameter_validation.py) — typed parse,
+range membership incl. open/closed interval edges, defaults, required,
+aliases, dependency ordering, error classification.
+"""
+
+import pytest
+
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+from sagemaker_xgboost_container_tpu.toolkit.hyperparameters import (
+    CategoricalHyperparameter,
+    CommaSeparatedListHyperparameter,
+    ContinuousHyperparameter,
+    Hyperparameters,
+    IntegerHyperparameter,
+    Interval,
+    NestedListHyperparameter,
+    TupleHyperparameter,
+    dependencies_validator,
+    range_validator,
+)
+
+
+def test_interval_membership():
+    iv = Interval(min_closed=0, max_open=1)
+    assert 0 in iv
+    assert 0.5 in iv
+    assert 1 not in iv
+    assert -0.1 not in iv
+
+    iv = Interval(min_open=0)
+    assert 0 not in iv
+    assert 1e9 in iv
+
+    unbounded = Interval()
+    assert -1e30 in unbounded and 1e30 in unbounded
+
+
+def test_interval_str():
+    assert str(Interval(min_closed=0, max_closed=1)) == "[0, 1]"
+    assert str(Interval(min_open=0)) == "(0, +inf)"
+
+
+def test_interval_rejects_double_bounds():
+    with pytest.raises(exc.AlgorithmError):
+        Interval(min_open=0, min_closed=0)
+
+
+def test_integer_parse_and_range():
+    hps = Hyperparameters(
+        IntegerHyperparameter(name="n", range=Interval(min_closed=1), required=True)
+    )
+    assert hps.validate({"n": "5"}) == {"n": 5}
+    with pytest.raises(exc.UserError):
+        hps.validate({"n": "0"})
+    with pytest.raises(exc.UserError):
+        hps.validate({"n": "abc"})
+
+
+def test_required_and_default():
+    hps = Hyperparameters(
+        IntegerHyperparameter(name="a", range=Interval(), required=True),
+        ContinuousHyperparameter(name="b", range=Interval(), required=False, default=0.5),
+    )
+    out = hps.validate({"a": "1"})
+    assert out == {"a": 1, "b": 0.5}
+    with pytest.raises(exc.UserError, match="Missing required"):
+        hps.validate({"b": "1.0"})
+
+
+def test_extraneous_hyperparameter():
+    hps = Hyperparameters(IntegerHyperparameter(name="a", range=Interval(), required=False))
+    with pytest.raises(exc.UserError, match="Extraneous"):
+        hps.validate({"zzz": "1"})
+
+
+def test_categorical():
+    hps = Hyperparameters(
+        CategoricalHyperparameter(name="c", range=["x", "y"], required=False)
+    )
+    assert hps.validate({"c": "x"}) == {"c": "x"}
+    with pytest.raises(exc.UserError):
+        hps.validate({"c": "z"})
+
+
+def test_comma_separated_list():
+    hps = Hyperparameters(
+        CommaSeparatedListHyperparameter(name="l", range=["a", "b", "c"], required=False)
+    )
+    assert hps.validate({"l": "a,b"}) == {"l": ["a", "b"]}
+    with pytest.raises(exc.UserError):
+        hps.validate({"l": "a,zzz"})
+
+
+def test_nested_list():
+    hps = Hyperparameters(
+        NestedListHyperparameter(name="n", range=Interval(min_closed=0), required=False)
+    )
+    assert hps.validate({"n": "[[0, 1], [2]]"}) == {"n": [[0, 1], [2]]}
+    with pytest.raises(exc.UserError):
+        hps.validate({"n": "[[-1]]"})
+
+
+def test_tuple():
+    hps = Hyperparameters(
+        TupleHyperparameter(name="t", range=[-1, 0, 1], required=False)
+    )
+    assert hps.validate({"t": "(1, -1)"}) == {"t": (1, -1)}
+    assert hps.validate({"t": "(1)"}) == {"t": (1,)}
+    with pytest.raises(exc.UserError):
+        hps.validate({"t": "(2,)"})
+
+
+def test_custom_range_validator():
+    @range_validator(["ok"])
+    def rng(choices, value):
+        return value in choices
+
+    hps = Hyperparameters(CategoricalHyperparameter(name="c", range=rng, required=False))
+    assert hps.validate({"c": "ok"}) == {"c": "ok"}
+    with pytest.raises(exc.UserError):
+        hps.validate({"c": "nope"})
+
+
+def test_dependencies_run_in_topological_order():
+    seen = {}
+
+    @dependencies_validator(["base"])
+    def needs_base(value, deps):
+        seen["deps"] = dict(deps)
+        if deps.get("base") == "off":
+            raise exc.UserError("incompatible")
+
+    hps = Hyperparameters(
+        CategoricalHyperparameter(name="base", range=["on", "off"], required=False),
+        CategoricalHyperparameter(
+            name="child", range=["v"], dependencies=needs_base, required=False
+        ),
+    )
+    hps.validate({"child": "v", "base": "on"})
+    assert seen["deps"] == {"base": "on"}
+    with pytest.raises(exc.UserError):
+        hps.validate({"child": "v", "base": "off"})
+    # dependency absent: validator still runs with empty deps
+    hps.validate({"child": "v"})
+
+
+def test_aliases():
+    hps = Hyperparameters(
+        ContinuousHyperparameter(name="eta", range=Interval(min_closed=0), required=False)
+    )
+    hps.declare_alias("eta", "learning_rate")
+    assert hps.validate({"learning_rate": "0.3"}) == {"eta": 0.3}
+
+
+def test_requires_range_enforced():
+    with pytest.raises(exc.AlgorithmError):
+        IntegerHyperparameter(name="x", required=False)
+
+
+def test_required_or_default_enforced():
+    with pytest.raises(exc.AlgorithmError):
+        CategoricalHyperparameter(name="x", range=["a"])
+
+
+def test_format_emits_createalgorithm_spec():
+    hps = Hyperparameters(
+        IntegerHyperparameter(
+            name="n",
+            range=Interval(min_closed=1, max_closed=10),
+            required=True,
+            tunable=True,
+            tunable_recommended_range=Interval(
+                min_closed=1, max_closed=5, scale=Interval.LINEAR_SCALE
+            ),
+        )
+    )
+    spec = hps.format()
+    assert spec[0]["Name"] == "n"
+    assert spec[0]["Type"] == "Integer"
+    assert spec[0]["Range"]["IntegerParameterRangeSpecification"] == {
+        "MinValue": "1",
+        "MaxValue": "10",
+    }
+    tunable = hps.format_tunable()
+    assert tunable["IntegerParameterRanges"][0]["ScalingType"] == "Linear"

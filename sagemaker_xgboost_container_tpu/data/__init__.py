@@ -1,0 +1,4 @@
+from .binning import BinnedMatrix, bin_matrix, compute_cut_points  # noqa: F401
+from .content_types import get_content_type  # noqa: F401
+from .matrix import DataMatrix  # noqa: F401
+from .readers import get_data_matrix, get_size, validate_data_file_path  # noqa: F401

@@ -1,0 +1,446 @@
+"""Channel ingestion: staged file discovery + csv/libsvm/parquet/recordio readers.
+
+Re-implements the reference's `data_utils.py` loading pipeline with a
+DataMatrix destination instead of xgb.DMatrix:
+
+* symlink staging of (possibly nested) channel directories into one flat dir,
+  depth-capped at MAX_FOLDER_DEPTH with a warning (reference :476-545),
+* data-file filtering (hidden/underscore/cache files skipped, :120-140),
+* first-line format validation for csv/libsvm (:203-286),
+* readers: CSV via pandas with sniffed delimiter and label in column 0
+  (weight in column 1 when csv_weights=1, :289-318), libsvm with optional
+  ``label:weight`` and ``qid:`` tokens (:348-365), parquet via pyarrow with
+  label in the first column (:368-390), recordio-protobuf (:418-459),
+* the "no labels" UserError and size/redundancy helpers (:586-592, :597-660).
+
+The pure-Python libsvm tokenizer here is the provisional path; the native C++
+parser in ``native/`` replaces it for large inputs.
+"""
+
+import csv as csv_module
+import logging
+import os
+import shutil
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..toolkit import exceptions as exc
+from . import content_types as ct
+from .matrix import DataMatrix
+from .recordio import read_recordio_protobuf
+
+logger = logging.getLogger(__name__)
+
+MAX_FOLDER_DEPTH = 3
+STAGING_DIR = "/tmp/sagemaker_xgboost_tpu_input_data"
+
+INVALID_CONTENT_FORMAT_ERROR = (
+    "First line '{line_snippet}...' of file '{file_name}' is not "
+    "'{content_type}' format. Please ensure the file is in '{content_type}' format."
+)
+
+NO_LABEL_ERROR = (
+    "Got input data without labels. Please check the input data set. "
+    "If training job is running on multiple instances, please switch "
+    "to using single instance if number of records in the data set "
+    "is less than number of workers (16 * number of instance) in the cluster."
+)
+
+
+# ---------------------------------------------------------------------------
+# File discovery / staging
+# ---------------------------------------------------------------------------
+
+
+def _is_data_file(dir_path, file_name):
+    if not os.path.isfile(os.path.join(dir_path, file_name)):
+        return False
+    if file_name.startswith(".") or file_name.startswith("_"):
+        return False
+    if ".cache" in file_name and ("dtrain" in file_name or "dval" in file_name):
+        return False
+    return True
+
+
+def _link_tree(dest_dir, src, depth):
+    if depth > MAX_FOLDER_DEPTH:
+        raise exc.UserError("Folder depth exceed the limit: {}.".format(MAX_FOLDER_DEPTH))
+    if os.path.isfile(src):
+        link = os.path.join(dest_dir, os.path.basename(src) + str(hash(src)))
+        os.symlink(src, link)
+        return
+    for entry in os.scandir(src):
+        if entry.is_file():
+            link = os.path.join(dest_dir, entry.name + str(hash(entry.path)))
+            os.symlink(entry.path, link)
+        elif entry.is_dir():
+            _link_tree(dest_dir, entry.path, depth + 1)
+
+
+def stage_input_files(data_path, staging_dir=STAGING_DIR):
+    """Flatten one or more channel paths into a staging dir of symlinks.
+
+    Returns the staging dir, or None when the path does not exist (the caller
+    treats that as "this host has no data" for cluster-membership purposes).
+    """
+    shutil.rmtree(staging_dir, ignore_errors=True)
+    os.makedirs(staging_dir)
+    paths = data_path if isinstance(data_path, list) else [data_path]
+    found_any = False
+    for path in paths:
+        if not os.path.exists(path):
+            logger.info("File path %s does not exist!", path)
+            continue
+        found_any = True
+        try:
+            _link_tree(staging_dir, path, 1)
+        except exc.UserError as e:
+            if "Folder depth exceed" in str(e):
+                logger.warning(
+                    "The depth of folder %s exceeds the limit %d. Files in deeper sub dirs "
+                    "won't be loaded. Please adjust the folder structure accordingly.",
+                    path,
+                    MAX_FOLDER_DEPTH,
+                )
+            else:
+                raise
+    return staging_dir if found_any else None
+
+
+def _list_data_files(path):
+    if os.path.isfile(path):
+        return [path]
+    return sorted(
+        os.path.join(path, f) for f in os.listdir(path) if _is_data_file(path, f)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Format validation (first-line sniffing)
+# ---------------------------------------------------------------------------
+
+
+def _sniff_csv_delimiter(sample_line):
+    try:
+        delimiter = csv_module.Sniffer().sniff(sample_line).delimiter
+    except Exception as e:
+        raise exc.UserError(
+            "Could not determine delimiter on line {}:\n{}".format(sample_line[:50], e)
+        )
+    return delimiter
+
+
+def _is_valid_libsvm_label(token):
+    parts = token.split(":")
+    if len(parts) > 2:
+        return False
+    for part in parts:
+        try:
+            float(part)
+        except ValueError:
+            return False
+    return True
+
+
+def _count_libsvm_features(line):
+    """-1 if the line is not valid libsvm; else the number of idx:val pairs."""
+    tokens = line.split()
+    if not tokens or not _is_valid_libsvm_label(tokens[0]):
+        return -1
+    count = 0
+    for token in tokens[1:]:
+        if token.startswith("qid:"):
+            continue
+        halves = token.split(":")
+        if len(halves) != 2:
+            return -1
+        count += 1
+    return count
+
+
+def _validate_csv_file(path):
+    with open(path, "r", errors="ignore") as f:
+        _sniff_csv_delimiter(f.readline())
+
+
+def _validate_libsvm_file(path):
+    with open(path, "r", errors="ignore") as f:
+        for line in f:
+            n = _count_libsvm_features(line.rstrip("\n"))
+            if n > 1:
+                return
+            if n < 0:
+                raise exc.UserError(
+                    INVALID_CONTENT_FORMAT_ERROR.format(
+                        line_snippet=line[:50],
+                        file_name=os.path.basename(path),
+                        content_type="LIBSVM",
+                    )
+                )
+    logger.warning(
+        "File %s is not an invalid LIBSVM file but has no features. "
+        "Accepting simple validation.",
+        os.path.basename(path),
+    )
+
+
+def validate_data_file_path(data_path, content_type):
+    parsed = ct.get_content_type(content_type)
+    if not os.path.exists(data_path):
+        raise exc.UserError("{} is not a valid path!".format(data_path))
+    if os.path.isfile(data_path):
+        files = [data_path]
+    else:
+        leaf_dir = None
+        for root, dirs, _files in os.walk(data_path):
+            if not dirs:
+                leaf_dir = root
+                break
+        files = [
+            os.path.join(leaf_dir, f)
+            for f in os.listdir(leaf_dir)
+            if _is_data_file(leaf_dir, f)
+        ]
+    if parsed == ct.CSV:
+        for f in files:
+            _validate_csv_file(f)
+    elif parsed == ct.LIBSVM:
+        for f in files:
+            _validate_libsvm_file(f)
+    # parquet / recordio: binary formats, validated at parse time
+
+
+# ---------------------------------------------------------------------------
+# Readers
+# ---------------------------------------------------------------------------
+
+
+def _read_csv_files(path, csv_weights=0):
+    import pandas as pd
+
+    files = _list_data_files(path)
+    if not files:
+        return None
+    with open(files[0], "r", errors="ignore") as f:
+        delimiter = _sniff_csv_delimiter(f.readline())
+    frames = [
+        pd.read_csv(f, header=None, delimiter=delimiter, dtype=np.float32) for f in files
+    ]
+    data = pd.concat(frames, axis=0, ignore_index=True).to_numpy(dtype=np.float32)
+    if data.shape[1] < 2:
+        raise exc.UserError(
+            "CSV data needs at least a label column and one feature column"
+        )
+    labels = data[:, 0]
+    if csv_weights == 1:
+        if data.shape[1] < 3:
+            raise exc.UserError("csv_weights=1 requires a weight column after the label")
+        return DataMatrix(data[:, 2:], labels=labels, weights=data[:, 1])
+    return DataMatrix(data[:, 1:], labels=labels)
+
+
+def parse_libsvm_text(text, num_col=None):
+    """Tokenize libsvm text into (csr, labels, weights, qids).
+
+    Accepts ``<label>(:<weight>) (qid:<q>) <idx>:<val> ...``. Indices are
+    taken verbatim as 0-based column ids, matching xgboost's file parser.
+    """
+    labels, weights, qids = [], [], []
+    data, indices, indptr = [], [], [0]
+    has_weights = has_qids = False
+    for lineno, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        tokens = line.split()
+        head = tokens[0].split(":")
+        try:
+            labels.append(float(head[0]))
+            if len(head) == 2:
+                weights.append(float(head[1]))
+                has_weights = True
+            else:
+                weights.append(1.0)
+            for token in tokens[1:]:
+                key, _, value = token.partition(":")
+                if key == "qid":
+                    qids.append(int(value))
+                    has_qids = True
+                    continue
+                indices.append(int(key))
+                data.append(float(value))
+        except ValueError as e:
+            raise exc.UserError(
+                "Malformed LIBSVM line {}: '{}'".format(lineno + 1, line[:50]), caused_by=e
+            )
+        indptr.append(len(indices))
+    n = len(labels)
+    if n == 0:
+        return None
+    width = num_col or (max(indices) + 1 if indices else 1)
+    csr = sp.csr_matrix(
+        (
+            np.asarray(data, dtype=np.float32),
+            np.asarray(indices, dtype=np.int64),
+            np.asarray(indptr, dtype=np.int64),
+        ),
+        shape=(n, width),
+    )
+    return (
+        csr,
+        np.asarray(labels, dtype=np.float32),
+        np.asarray(weights, dtype=np.float32) if has_weights else None,
+        np.asarray(qids, dtype=np.int64) if has_qids else None,
+    )
+
+
+def _qids_to_groups(qids):
+    """Contiguous qid runs -> group-size array (ranking objectives)."""
+    if qids is None:
+        return None
+    change = np.flatnonzero(np.diff(qids)) + 1
+    bounds = np.concatenate([[0], change, [len(qids)]])
+    return np.diff(bounds).astype(np.int32)
+
+
+def _read_libsvm_files(path):
+    files = _list_data_files(path)
+    if not files:
+        return None
+    parts = []
+    for f in files:
+        with open(f, "r", errors="ignore") as fh:
+            parsed = parse_libsvm_text(fh.read())
+        if parsed is not None:
+            parts.append(parsed)
+    if not parts:
+        return None
+    width = max(p[0].shape[1] for p in parts)
+    csr = sp.vstack(
+        [sp.csr_matrix((p[0].data, p[0].indices, p[0].indptr), shape=(p[0].shape[0], width)) for p in parts]
+    ).tocsr()
+    labels = np.concatenate([p[1] for p in parts])
+    weights = (
+        np.concatenate([p[2] if p[2] is not None else np.ones(p[0].shape[0], np.float32) for p in parts])
+        if any(p[2] is not None for p in parts)
+        else None
+    )
+    qids = (
+        np.concatenate([p[3] for p in parts]) if all(p[3] is not None for p in parts) else None
+    )
+    return DataMatrix(csr, labels=labels, weights=weights, groups=_qids_to_groups(qids))
+
+
+def _read_parquet_files(path):
+    import pyarrow.parquet as pq
+
+    files = _list_data_files(path)
+    if not files:
+        return None
+    tables = [pq.read_table(f) for f in files]
+    arrays = [t.to_pandas().to_numpy(dtype=np.float32) for t in tables]
+    data = np.concatenate(arrays, axis=0) if len(arrays) > 1 else arrays[0]
+    return DataMatrix(data[:, 1:], labels=data[:, 0])
+
+
+def _read_recordio_files(path):
+    files = _list_data_files(path)
+    if not files:
+        return None
+    bufs = []
+    for f in files:
+        with open(f, "rb") as fh:
+            bufs.append(fh.read())
+    features, labels = read_recordio_protobuf(b"".join(bufs))
+    return DataMatrix(features, labels=labels)
+
+
+def get_data_matrix(data_path, content_type, csv_weights=0, is_pipe=False):
+    """Load a channel into a DataMatrix. The reference's `get_dmatrix`.
+
+    Returns None when the path holds no data (the host sits out of training).
+    Raises UserError when data exists but carries no labels.
+    """
+    if is_pipe:
+        raise exc.UserError(
+            "Pipe mode is no longer supported. Please use Fast File mode (default) "
+            "instead. Set input_mode='File' in your SageMaker Estimator or TrainingInput."
+        )
+    staged = stage_input_files(data_path)
+    if staged is None:
+        return None
+    parsed = ct.get_content_type(content_type)
+    try:
+        if parsed == ct.CSV:
+            dmatrix = _read_csv_files(staged, csv_weights)
+        elif parsed == ct.LIBSVM:
+            dmatrix = _read_libsvm_files(staged)
+        elif parsed == ct.PARQUET:
+            dmatrix = _read_parquet_files(staged)
+        else:
+            dmatrix = _read_recordio_files(staged)
+    except exc.UserError:
+        raise
+    except Exception as e:
+        raise exc.UserError(
+            "Failed to load {} data with exception:\n{}".format(parsed, e), caused_by=e
+        )
+    if dmatrix is not None and dmatrix.get_label().size == 0:
+        raise exc.UserError(NO_LABEL_ERROR)
+    if dmatrix is not None and not np.isfinite(dmatrix.get_label()).all():
+        raise exc.UserError(
+            "Input data contains non-finite labels (NaN/inf). Please check that the "
+            "label column is present and numeric in every row."
+        )
+    return dmatrix
+
+
+# ---------------------------------------------------------------------------
+# Size / redundancy helpers
+# ---------------------------------------------------------------------------
+
+
+def get_size(data_path, is_pipe=False):
+    if is_pipe and os.path.exists("{}_0".format(data_path)):
+        return 1
+    if not os.path.exists(data_path):
+        logger.info("Path %s does not exist!", data_path)
+        return 0
+    if os.path.isfile(data_path):
+        return os.path.getsize(data_path)
+    total = 0
+    for root, _dirs, files in os.walk(data_path):
+        for name in files:
+            if name.startswith("."):
+                raise exc.UserError(
+                    "Hidden file found in the data path! Remove that before training."
+                )
+            total += os.path.getsize(os.path.join(root, name))
+    return total
+
+
+def check_data_redundancy(train_path, validate_path):
+    if not os.path.exists(train_path):
+        raise exc.UserError("training data's path is not existed")
+    if not os.path.exists(validate_path):
+        raise exc.UserError("validation data's path is not existed")
+    train_files = {
+        f for f in os.listdir(train_path) if os.path.isfile(os.path.join(train_path, f))
+    }
+    val_files = {
+        f for f in os.listdir(validate_path) if os.path.isfile(os.path.join(validate_path, f))
+    }
+    for name in train_files & val_files:
+        a = os.path.getsize(os.path.join(train_path, name))
+        b = os.path.getsize(os.path.join(validate_path, name))
+        if a == b:
+            logger.warning(
+                "Suspected identical files found. (%s and %s with same size %d bytes). "
+                "Note: Duplicate data in the training set and validation set is usually "
+                "not intentional and can impair the validity of the model evaluation by "
+                "the validation score.",
+                os.path.join(train_path, name),
+                os.path.join(validate_path, name),
+                b,
+            )

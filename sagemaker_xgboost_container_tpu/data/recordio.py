@@ -1,0 +1,157 @@
+"""RecordIO-framed aialgs protobuf reader/writer.
+
+Wire format (public SageMaker spec; reference reader at
+`recordio_protobuf.py:26-141`): each record is ``<magic u32 LE = 0xCED7230A>
+<length u32 LE> <payload> <pad to 4 bytes>``; the payload is an
+``aialgs.data.Record`` proto whose ``features["values"]`` tensor is dense
+(values only) or sparse (values + keys + shape). The proto module is generated
+from ``native/proto/record.proto`` (kept in-tree).
+
+Reader returns (features, labels): features dense ndarray or CSR, labels
+ndarray or None. The writer side lives here too because serving emits
+recordio-protobuf responses (reference serve_utils.py:453-548).
+"""
+
+import struct
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..toolkit import exceptions as exc
+from . import record_pb2
+
+RECORDIO_MAGIC = 0xCED7230A
+_HEADER = struct.Struct("<II")
+
+
+def iter_records(buf):
+    """Yield raw protobuf payloads from a RecordIO byte buffer."""
+    offset, total = 0, len(buf)
+    while offset + _HEADER.size <= total:
+        magic, length = _HEADER.unpack_from(buf, offset)
+        if magic != RECORDIO_MAGIC:
+            raise exc.UserError(
+                "Invalid RecordIO magic at offset {}: 0x{:08x}".format(offset, magic)
+            )
+        offset += _HEADER.size
+        padded = (length + 3) & ~3
+        if offset + length > total:
+            raise exc.UserError("Truncated RecordIO record at offset {}".format(offset))
+        yield buf[offset : offset + length]
+        offset += padded
+
+
+def _tensor_of(value):
+    """Pick the populated tensor arm of a Value message, or None."""
+    arm = value.WhichOneof("value")
+    if arm == "float32_tensor":
+        return value.float32_tensor, np.float32
+    if arm == "float64_tensor":
+        return value.float64_tensor, np.float64
+    if arm == "int32_tensor":
+        return value.int32_tensor, np.int32
+    return None, None
+
+
+def read_recordio_protobuf(buf):
+    """Decode a RecordIO-protobuf buffer into (features, labels)."""
+    dense_rows = []
+    sparse_vals, sparse_keys, sparse_indptr = [], [], [0]
+    labels = []
+    any_sparse = False
+    ncols_seen = 0
+
+    for payload in iter_records(buf):
+        record = record_pb2.Record()
+        record.ParseFromString(payload)
+        if "values" not in record.features:
+            continue
+        tensor, dtype = _tensor_of(record.features["values"])
+        if tensor is None:
+            continue
+        values = np.asarray(tensor.values, dtype=dtype)
+        keys = np.asarray(tensor.keys, dtype=np.int64)
+        shape = list(tensor.shape)
+
+        if len(keys) or shape:
+            # sparse row (keys present, or an explicitly-shaped empty row)
+            any_sparse = True
+            sparse_vals.append(values.astype(np.float32, copy=False))
+            sparse_keys.append(keys)
+            sparse_indptr.append(sparse_indptr[-1] + len(keys))
+            if shape:
+                ncols_seen = max(ncols_seen, int(shape[0]))
+            elif len(keys):
+                ncols_seen = max(ncols_seen, int(keys.max()) + 1)
+        else:
+            dense_rows.append(values.astype(np.float32, copy=False))
+            ncols_seen = max(ncols_seen, len(values))
+
+        if "values" in record.label:
+            ltensor, ldtype = _tensor_of(record.label["values"])
+            if ltensor is not None:
+                labels.append(np.asarray(ltensor.values, dtype=ldtype))
+
+    if not dense_rows and not sparse_vals:
+        raise exc.UserError("No records found in RecordIO-Protobuf data")
+
+    if any_sparse:
+        if dense_rows:
+            raise exc.UserError("Mixed dense and sparse records in RecordIO-Protobuf data")
+        features = sp.csr_matrix(
+            (
+                np.concatenate(sparse_vals) if sparse_vals else np.empty(0, np.float32),
+                np.concatenate(sparse_keys) if sparse_keys else np.empty(0, np.int64),
+                np.asarray(sparse_indptr),
+            ),
+            shape=(len(sparse_indptr) - 1, max(ncols_seen, 1)),
+        )
+    else:
+        features = np.vstack(dense_rows)
+
+    label_arr = np.concatenate(labels, axis=None) if labels else None
+    return features, label_arr
+
+
+# ---------------------------------------------------------------------------
+# Writer (serving responses, test fixtures)
+# ---------------------------------------------------------------------------
+
+
+def _frame(payload):
+    pad = b"\x00" * ((4 - len(payload) % 4) % 4)
+    return _HEADER.pack(RECORDIO_MAGIC, len(payload)) + payload + pad
+
+
+def write_recordio_protobuf(features, labels=None, extra_label_maps=None):
+    """Encode rows into a RecordIO-protobuf byte buffer.
+
+    ``extra_label_maps``: optional dict of name -> per-row array, emitted into
+    each record's label map (used by selectable-inference recordio output).
+    """
+    is_sparse = sp.issparse(features)
+    if is_sparse:
+        features = features.tocsr()
+    out = []
+    n = features.shape[0]
+    for i in range(n):
+        record = record_pb2.Record()
+        tensor = record.features["values"].float32_tensor
+        if is_sparse:
+            row = features.getrow(i)
+            tensor.values.extend(float(v) for v in row.data)
+            tensor.keys.extend(int(k) for k in row.indices)
+            tensor.shape.append(features.shape[1])
+        else:
+            tensor.values.extend(float(v) for v in np.asarray(features[i]).ravel())
+        if labels is not None:
+            record.label["values"].float32_tensor.values.extend(
+                np.atleast_1d(np.asarray(labels[i], dtype=np.float32)).tolist()
+            )
+        if extra_label_maps:
+            for name, arr in extra_label_maps.items():
+                record.label[name].float32_tensor.values.extend(
+                    np.atleast_1d(np.asarray(arr[i], dtype=np.float32)).tolist()
+                )
+        out.append(_frame(record.SerializeToString()))
+    return b"".join(out)

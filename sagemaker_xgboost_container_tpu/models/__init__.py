@@ -1,0 +1,3 @@
+from .booster import TrainConfig, train  # noqa: F401
+from .forest import Forest, Tree  # noqa: F401
+from .objectives import create_objective  # noqa: F401

@@ -1,0 +1,348 @@
+"""The boosting engine: ``train()`` — the TPU replacement for ``xgb.train``.
+
+Reference hot loop (algorithm_mode/train.py:367-376) calls into libxgboost;
+here each boosting round is one jitted XLA program: objective grad/hess ->
+level-wise tree build (ops/tree_build) -> margin updates for train and every
+eval set — the only host work per round is pulling the tree's small node
+arrays (O(2^max_depth)) for the Forest and the eval scalars for callbacks.
+
+Distribution: when a mesh is supplied, rows are sharded over the "data" axis
+with ``shard_map``; the single ``lax.psum`` inside the histogram op is the
+entire cross-host protocol (replacing Rabit allreduce + tracker topology,
+SURVEY.md §5). Trees come out bitwise identical on every shard, so the
+"master saves the model" contract is trivially consistent.
+
+Callback protocol mirrors xgboost's (before_training / after_iteration ->
+bool stop / after_training) so the orchestration layer's checkpoint, early
+stop, and monitor callbacks port naturally.
+"""
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.binning import bin_matrix
+from ..ops.tree_build import build_tree, max_nodes_for_depth, predict_binned
+from ..toolkit import exceptions as exc
+from . import eval_metrics
+from . import objectives as objectives_mod
+from .forest import Forest, compact_padded_tree
+
+logger = logging.getLogger(__name__)
+
+
+class TrainConfig:
+    """Parsed + defaulted booster parameters (static across rounds)."""
+
+    def __init__(self, params):
+        p = dict(params or {})
+        self.eta = float(p.get("eta", 0.3))
+        self.max_depth = int(p.get("max_depth", 6) or 6)
+        self.reg_lambda = float(p.get("lambda", 1.0))
+        self.alpha = float(p.get("alpha", 0.0))
+        self.gamma = float(p.get("gamma", 0.0))
+        self.min_child_weight = float(p.get("min_child_weight", 1.0))
+        self.max_delta_step = float(p.get("max_delta_step", 0.0))
+        self.max_bin = int(p.get("max_bin", 256) or 256)
+        self.subsample = float(p.get("subsample", 1.0))
+        self.colsample_bytree = float(p.get("colsample_bytree", 1.0))
+        self.colsample_bylevel = float(p.get("colsample_bylevel", 1.0))
+        self.seed = int(p.get("seed", 0))
+        self.objective = p.get("objective", "reg:squarederror")
+        self.num_class = int(p.get("num_class", 0) or 0)
+        self.base_score = float(p.get("base_score", 0.5))
+        self.tree_method = p.get("tree_method", "auto")
+        self.monotone_constraints = p.get("monotone_constraints")
+        self.eval_metric = p.get("eval_metric")
+        self.num_parallel_tree = int(p.get("num_parallel_tree", 1) or 1)
+        self.objective_params = p
+        if self.objective == "count:poisson" and "max_delta_step" not in p:
+            self.max_delta_step = 0.7
+        if self.tree_method == "gpu_hist":
+            raise exc.UserError(
+                "tree_method 'gpu_hist' is not available in the TPU container; use 'hist'."
+            )
+
+
+def _eval_metric_names(config, objective):
+    metrics = config.eval_metric
+    if metrics is None:
+        metrics = [objective.default_metric]
+    elif isinstance(metrics, str):
+        metrics = [metrics]
+    return list(metrics)
+
+
+class _TrainingSession:
+    """Device state for one training run (bins, margins, jitted round fns)."""
+
+    def __init__(self, config, dtrain, evals, forest, mesh=None):
+        self.config = config
+        self.objective = forest.objective()
+        self.num_group = self.objective.num_output_group
+        self.mesh = mesh
+
+        labels = dtrain.labels
+        self.objective.validate_labels(labels)
+
+        self.train_binned = bin_matrix(dtrain, config.max_bin)
+        self.cuts = self.train_binned.cut_points
+        self.num_cuts = jnp.asarray(
+            np.array([len(c) for c in self.cuts], np.int32)
+        )
+        self.eval_sets = []
+        for dm, name in evals:
+            binned = (
+                self.train_binned
+                if dm is dtrain
+                else bin_matrix(dm, config.max_bin, cut_points=self.cuts)
+            )
+            self.eval_sets.append((name, dm, binned))
+
+        n = dtrain.num_row
+        self.n = n
+        self.bins = jnp.asarray(self.train_binned.bins)
+        self.labels = jnp.asarray(labels)
+        self.weights = jnp.asarray(dtrain.get_weight())
+        self.groups = dtrain.groups
+        base = self.objective.base_margin(forest.base_score)
+        shape = (n,) if self.num_group == 1 else (n, self.num_group)
+        if forest.trees:
+            # resume: margins from the existing forest
+            margin = forest.predict_margin(dtrain.features)
+            self.margins = jnp.asarray(margin.reshape(shape))
+        else:
+            self.margins = jnp.full(shape, base, jnp.float32)
+        self.eval_margins = []
+        for name, dm, binned in self.eval_sets:
+            eshape = (dm.num_row,) if self.num_group == 1 else (dm.num_row, self.num_group)
+            if binned is self.train_binned:
+                self.eval_margins.append(None)  # shares training margins
+            elif forest.trees:
+                self.eval_margins.append(
+                    jnp.asarray(forest.predict_margin(dm.features).reshape(eshape))
+                )
+            else:
+                self.eval_margins.append(jnp.full(eshape, base, jnp.float32))
+        self.rng = jax.random.PRNGKey(config.seed)
+
+        monotone = None
+        if config.monotone_constraints:
+            mono = np.zeros(dtrain.num_col, np.int32)
+            vals = config.monotone_constraints
+            mono[: len(vals)] = np.asarray(vals, np.int32)
+            monotone = jnp.asarray(mono)
+        self.monotone = monotone
+
+        self._round_fn = self._make_round_fn()
+        self._apply_fn = self._make_apply_fn()
+
+    # ------------------------------------------------------------------ jit
+    def _make_round_fn(self):
+        cfg = self.config
+        num_bins = self.train_binned.num_bins
+        builder = partial(
+            build_tree,
+            max_depth=cfg.max_depth,
+            num_bins=num_bins,
+            reg_lambda=cfg.reg_lambda,
+            alpha=cfg.alpha,
+            gamma=cfg.gamma,
+            min_child_weight=cfg.min_child_weight,
+            eta=cfg.eta,
+            max_delta_step=cfg.max_delta_step,
+        )
+        grad_hess = self.objective.grad_hess
+        num_group = self.num_group
+        subsample = cfg.subsample
+
+        def one_round(bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone):
+            g, h = grad_hess(margins, labels, weights)
+            if subsample < 1.0:
+                keep = (
+                    jax.random.uniform(rng, (bins.shape[0],)) < subsample
+                ).astype(jnp.float32)
+                if num_group == 1:
+                    g, h = g * keep, h * keep
+                else:
+                    g, h = g * keep[:, None], h * keep[:, None]
+            if num_group == 1:
+                tree, row_out = builder(
+                    bins, g, h, num_cuts, feature_mask=feature_mask, monotone=monotone
+                )
+                margins = margins + row_out
+            else:
+                tree, row_out = jax.vmap(
+                    lambda gc, hc: builder(
+                        bins, gc, hc, num_cuts, feature_mask=feature_mask, monotone=monotone
+                    )
+                )(g.T, h.T)
+                margins = margins + row_out.T
+            return tree, margins
+
+        return jax.jit(one_round, donate_argnums=(1,))
+
+    def _make_apply_fn(self):
+        cfg = self.config
+        num_bins = self.train_binned.num_bins
+        num_group = self.num_group
+
+        def apply_tree(tree, bins, margins):
+            if num_group == 1:
+                return margins + predict_binned(tree, bins, cfg.max_depth, num_bins)
+            deltas = jax.vmap(
+                lambda t: predict_binned(t, bins, cfg.max_depth, num_bins)
+            )(tree)
+            return margins + deltas.T
+
+        return jax.jit(apply_tree, donate_argnums=(2,))
+
+    # ---------------------------------------------------------------- round
+    def run_round(self):
+        self.rng, sub, colrng = jax.random.split(self.rng, 3)
+        d = self.bins.shape[1]
+        if self.config.colsample_bytree < 1.0:
+            k = max(1, int(round(self.config.colsample_bytree * d)))
+            chosen = jax.random.permutation(colrng, d)[:k]
+            feature_mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
+        else:
+            feature_mask = None
+        tree, self.margins = self._round_fn(
+            self.bins,
+            self.margins,
+            self.labels,
+            self.weights,
+            self.num_cuts,
+            sub,
+            feature_mask,
+            self.monotone,
+        )
+        for i, (name, dm, binned) in enumerate(self.eval_sets):
+            if self.eval_margins[i] is not None:
+                self.eval_margins[i] = self._apply_fn(
+                    tree, jnp.asarray(binned.bins), self.eval_margins[i]
+                )
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    # ----------------------------------------------------------------- eval
+    def margins_for(self, index):
+        m = self.eval_margins[index]
+        return np.asarray(self.margins if m is None else m)
+
+    def evaluate(self, metric_names, feval=None):
+        """Returns list of (data_name, metric_name, value) per eval set."""
+        results = []
+        for i, (name, dm, binned) in enumerate(self.eval_sets):
+            margin = self.margins_for(i)
+            preds = self.objective.margin_to_prediction(margin)
+            prob_matrix = None
+            if self.num_group > 1:
+                e = np.exp(margin - margin.max(axis=1, keepdims=True))
+                prob_matrix = e / e.sum(axis=1, keepdims=True)
+            for metric in metric_names:
+                value = eval_metrics.evaluate(
+                    metric,
+                    preds if preds.ndim == 1 else preds,
+                    dm.labels,
+                    dm.weights,
+                    groups=dm.groups,
+                    prob_matrix=prob_matrix,
+                )
+                results.append((name, metric, value))
+            if feval is not None:
+                for metric_name, value in feval(preds, dm, margin=margin):
+                    results.append((name, metric_name, value))
+        return results
+
+
+def train(
+    params,
+    dtrain,
+    num_boost_round=10,
+    evals=(),
+    feval=None,
+    callbacks=None,
+    xgb_model=None,
+    verbose_eval=True,
+    mesh=None,
+):
+    """Train a Forest. API mirrors ``xgb.train`` for the orchestration layer.
+
+    xgb_model: a Forest or a model-file path to continue training from
+    (checkpoint resume — reference checkpointing.py:45-55).
+    """
+    config = TrainConfig(params)
+    callbacks = list(callbacks or [])
+
+    if xgb_model is None:
+        forest = Forest(
+            objective_name=config.objective,
+            objective_params={
+                k: v
+                for k, v in config.objective_params.items()
+                if k
+                in (
+                    "scale_pos_weight",
+                    "tweedie_variance_power",
+                    "huber_slope",
+                    "max_delta_step",
+                    "num_class",
+                )
+            },
+            base_score=config.base_score,
+            num_feature=dtrain.num_col,
+            num_class=config.num_class,
+            feature_names=dtrain.feature_names,
+        )
+    elif isinstance(xgb_model, Forest):
+        forest = xgb_model
+    else:
+        forest = Forest.load_model(xgb_model)
+    if forest.num_feature < dtrain.num_col and forest.trees:
+        raise exc.UserError("feature_names mismatch between checkpoint and data")
+    forest.num_feature = max(forest.num_feature, dtrain.num_col)
+
+    session = _TrainingSession(config, dtrain, list(evals), forest, mesh=mesh)
+    metric_names = _eval_metric_names(config, session.objective)
+
+    for cb in callbacks:
+        if hasattr(cb, "before_training"):
+            forest = cb.before_training(forest) or forest
+
+    evals_log = {}
+    start_round = forest.num_boosted_rounds
+    stop = False
+    for rnd in range(start_round, start_round + num_boost_round):
+        tree_np = session.run_round()
+        if session.num_group == 1:
+            trees = [compact_padded_tree(tree_np, session.cuts)]
+            info = [0]
+        else:
+            trees = [
+                compact_padded_tree(
+                    {k: v[c] for k, v in tree_np.items()}, session.cuts
+                )
+                for c in range(session.num_group)
+            ]
+            info = list(range(session.num_group))
+        forest.append_round(trees, info)
+
+        results = session.evaluate(metric_names, feval=feval) if session.eval_sets else []
+        for data_name, metric_name, value in results:
+            evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
+
+        for cb in callbacks:
+            if hasattr(cb, "after_iteration") and cb.after_iteration(
+                forest, rnd, evals_log
+            ):
+                stop = True
+        if stop:
+            break
+
+    for cb in callbacks:
+        if hasattr(cb, "after_training"):
+            forest = cb.after_training(forest) or forest
+    return forest

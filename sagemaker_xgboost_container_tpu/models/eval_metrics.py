@@ -1,0 +1,232 @@
+"""Built-in evaluation metrics (the xgboost ``eval_metric`` set).
+
+Host-side numpy implementations evaluated on *transformed* predictions
+(probabilities for logistic, class probabilities for softprob), matching
+xgboost's metric semantics. The stdout line they feed is the HPO scrape
+contract (algorithm/metrics.py); sklearn-backed "custom" metrics live in
+``metrics/custom_metrics.py`` mirroring the reference split
+(custom_metrics.py vs native metrics).
+
+Each metric: fn(preds, labels, weights) -> float. ``preds`` is what
+``Objective.margin_to_prediction`` returns except for the multiclass margin
+metrics, which receive the full [n, C] probability matrix.
+"""
+
+import numpy as np
+
+from ..toolkit import exceptions as exc
+
+_EPS = 1e-15
+
+
+def _w(weights, labels):
+    return np.ones_like(labels) if weights is None else weights
+
+
+def rmse(preds, labels, weights=None):
+    w = _w(weights, labels)
+    return float(np.sqrt(np.sum(w * (preds - labels) ** 2) / np.sum(w)))
+
+
+def mse(preds, labels, weights=None):
+    w = _w(weights, labels)
+    return float(np.sum(w * (preds - labels) ** 2) / np.sum(w))
+
+
+def mae(preds, labels, weights=None):
+    w = _w(weights, labels)
+    return float(np.sum(w * np.abs(preds - labels)) / np.sum(w))
+
+
+def mape(preds, labels, weights=None):
+    w = _w(weights, labels)
+    return float(np.sum(w * np.abs((labels - preds) / np.maximum(np.abs(labels), _EPS))) / np.sum(w))
+
+
+def rmsle(preds, labels, weights=None):
+    w = _w(weights, labels)
+    return float(
+        np.sqrt(np.sum(w * (np.log1p(np.maximum(preds, 0)) - np.log1p(labels)) ** 2) / np.sum(w))
+    )
+
+
+def mphe(preds, labels, weights=None, slope=1.0):
+    w = _w(weights, labels)
+    z = (preds - labels) / slope
+    return float(np.sum(w * (slope**2) * (np.sqrt(1 + z * z) - 1)) / np.sum(w))
+
+
+def logloss(preds, labels, weights=None):
+    w = _w(weights, labels)
+    p = np.clip(preds, _EPS, 1 - _EPS)
+    return float(-np.sum(w * (labels * np.log(p) + (1 - labels) * np.log(1 - p))) / np.sum(w))
+
+
+def error(preds, labels, weights=None, threshold=0.5):
+    w = _w(weights, labels)
+    pred_label = (preds > threshold).astype(np.float32)
+    return float(np.sum(w * (pred_label != labels)) / np.sum(w))
+
+
+def auc(preds, labels, weights=None):
+    """Weighted ROC-AUC via the Mann-Whitney statistic with tie midranks.
+
+    U = sum_pos w_i * rank_i - W_pos^2 / 2, where rank_i is the sample's
+    midrank in cumulative-weight space (ties share their group's midpoint);
+    AUC = U / (W_pos * W_neg).
+    """
+    w = _w(weights, labels)
+    pos = labels > 0
+    if not pos.any() or pos.all():
+        raise exc.UserError(
+            "Check failed: !auc_error AUC: the dataset only contains pos or neg samples"
+        )
+    order = np.argsort(preds, kind="stable")
+    sp, sw, spos = preds[order], w[order], pos[order]
+    _, inv = np.unique(sp, return_inverse=True)
+    group_w = np.bincount(inv, weights=sw)
+    group_end = np.cumsum(group_w)
+    ranks = (group_end - group_w / 2.0)[inv]
+    w_pos = float(np.sum(sw[spos]))
+    w_neg = float(np.sum(sw[~spos]))
+    u = float(np.sum(ranks[spos] * sw[spos])) - w_pos * w_pos / 2.0
+    return float(np.clip(u / (w_pos * w_neg), 0.0, 1.0))
+
+
+def aucpr(preds, labels, weights=None):
+    from sklearn.metrics import average_precision_score
+
+    return float(average_precision_score(labels, preds, sample_weight=weights))
+
+
+def merror(prob_matrix, labels, weights=None):
+    w = _w(weights, labels)
+    pred_label = np.argmax(prob_matrix, axis=1)
+    return float(np.sum(w * (pred_label != labels)) / np.sum(w))
+
+
+def mlogloss(prob_matrix, labels, weights=None):
+    w = _w(weights, labels)
+    p = np.clip(prob_matrix[np.arange(len(labels)), labels.astype(int)], _EPS, 1.0)
+    return float(-np.sum(w * np.log(p)) / np.sum(w))
+
+
+def poisson_nloglik(preds, labels, weights=None):
+    from scipy.special import gammaln
+
+    w = _w(weights, labels)
+    p = np.maximum(preds, _EPS)
+    return float(np.sum(w * (p - labels * np.log(p) + gammaln(labels + 1))) / np.sum(w))
+
+
+def gamma_nloglik(preds, labels, weights=None):
+    w = _w(weights, labels)
+    p = np.maximum(preds, _EPS)
+    y = np.maximum(labels, _EPS)
+    # xgboost uses deviance-based nloglik with psi = 1
+    return float(np.sum(w * (np.log(p) + y / p)) / np.sum(w))
+
+
+def gamma_deviance(preds, labels, weights=None):
+    w = _w(weights, labels)
+    p = np.maximum(preds, _EPS)
+    y = np.maximum(labels, _EPS)
+    return float(2.0 * np.sum(w * (np.log(p / y) + y / p - 1)) / np.sum(w))
+
+
+def tweedie_nloglik(preds, labels, weights=None, rho=1.5):
+    w = _w(weights, labels)
+    p = np.maximum(preds, _EPS)
+    a = labels * np.power(p, 1 - rho) / (1 - rho)
+    b = np.power(p, 2 - rho) / (2 - rho)
+    return float(np.sum(w * (-a + b)) / np.sum(w))
+
+
+def _dcg_at(scores_sorted_labels, k):
+    gains = (2.0**scores_sorted_labels - 1.0) / np.log2(np.arange(2, len(scores_sorted_labels) + 2))
+    if k:
+        gains = gains[:k]
+    return gains.sum()
+
+
+def ndcg(preds, labels, weights=None, groups=None, k=None):
+    """Mean NDCG over query groups (groups = group-size array)."""
+    if groups is None:
+        groups = np.asarray([len(labels)])
+    out, start = [], 0
+    for size in groups:
+        size = int(size)
+        sl = slice(start, start + size)
+        start += size
+        lab = labels[sl]
+        order = np.argsort(-preds[sl], kind="stable")
+        dcg = _dcg_at(lab[order], k)
+        ideal = _dcg_at(np.sort(lab)[::-1], k)
+        out.append(dcg / ideal if ideal > 0 else 1.0)
+    return float(np.mean(out))
+
+
+def map_metric(preds, labels, weights=None, groups=None, k=None):
+    """Mean average precision over query groups (binary relevance)."""
+    if groups is None:
+        groups = np.asarray([len(labels)])
+    out, start = [], 0
+    for size in groups:
+        size = int(size)
+        sl = slice(start, start + size)
+        start += size
+        lab = (labels[sl] > 0).astype(np.float64)
+        order = np.argsort(-preds[sl], kind="stable")
+        rel = lab[order]
+        if k:
+            rel = rel[:k]
+        hits = np.cumsum(rel)
+        precisions = hits / np.arange(1, len(rel) + 1)
+        denom = rel.sum()
+        out.append(float((precisions * rel).sum() / denom) if denom > 0 else 1.0)
+    return float(np.mean(out))
+
+
+_SIMPLE = {
+    "rmse": rmse,
+    "mse": mse,
+    "mae": mae,
+    "mape": mape,
+    "rmsle": rmsle,
+    "mphe": mphe,
+    "logloss": logloss,
+    "error": error,
+    "auc": auc,
+    "aucpr": aucpr,
+    "poisson-nloglik": poisson_nloglik,
+    "gamma-nloglik": gamma_nloglik,
+    "gamma-deviance": gamma_deviance,
+    "tweedie-nloglik": tweedie_nloglik,
+}
+
+_MULTI = {"merror": merror, "mlogloss": mlogloss}
+_RANKING = {"ndcg": ndcg, "map": map_metric}
+
+
+def is_native_metric(name):
+    base = name.split("@")[0]
+    return base in _SIMPLE or base in _MULTI or base in _RANKING
+
+
+def evaluate(name, preds, labels, weights=None, groups=None, prob_matrix=None):
+    """Dispatch one metric by its (possibly @-suffixed) name."""
+    base, _, suffix = name.partition("@")
+    if base in _MULTI:
+        if prob_matrix is None:
+            raise exc.AlgorithmError("metric {} needs the probability matrix".format(name))
+        return _MULTI[base](prob_matrix, labels, weights)
+    if base in _RANKING:
+        k = int(float(suffix)) if suffix else None
+        return _RANKING[base](preds, labels, weights, groups=groups, k=k)
+    if base == "error" and suffix:
+        return error(preds, labels, weights, threshold=float(suffix))
+    if base == "tweedie-nloglik" and suffix:
+        return tweedie_nloglik(preds, labels, weights, rho=float(suffix))
+    if base in _SIMPLE:
+        return _SIMPLE[base](preds, labels, weights)
+    raise exc.UserError("Unknown eval metric: {}".format(name))

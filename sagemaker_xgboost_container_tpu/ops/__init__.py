@@ -1,0 +1,4 @@
+from .histogram import level_histogram  # noqa: F401
+from .predict import forest_predict_margin  # noqa: F401
+from .split import find_best_splits, leaf_weight  # noqa: F401
+from .tree_build import build_tree, predict_binned  # noqa: F401

@@ -1,0 +1,53 @@
+"""Per-node gradient histograms on TPU.
+
+The hot op of the whole framework: for each tree level, accumulate
+(grad, hess) into a (node x feature x bin) tensor. This replaces libxgboost's
+OpenMP hist builder + Rabit allreduce (reference hot loop at
+algorithm_mode/train.py:367-376 -> C++): here it is a single
+``jax.ops.segment_sum`` over a flattened (node, feature, bin) index — XLA
+lowers it to a sorted scatter-add — followed by an optional ``lax.psum`` over
+the data-parallel mesh axis, which is the entire multi-host story (SURVEY.md
+§2.3 row 1).
+
+Index layout: seg = (node_local * d + feature) * B + bin, with one extra
+trash segment for rows whose node is already finalized (node_local < 0).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name=None):
+    """Build (G, H) histograms for one tree level.
+
+    Args:
+      bins: i32 [n, d] bin indices (missing bin included in num_bins).
+      grad, hess: f32 [n].
+      node_local: i32 [n]; position of the row's node within this level,
+        or negative when the row no longer participates.
+      num_nodes: static int — number of nodes at this level (2**level).
+      num_bins: static int — histogram width per feature (max_bin + 1).
+      axis_name: mesh axis to psum over, or None on a single device.
+
+    Returns:
+      (G, H): f32 [num_nodes, d, num_bins].
+    """
+    n, d = bins.shape
+    active = node_local >= 0
+    # inactive rows land in the trailing trash segment
+    safe_node = jnp.where(active, node_local, num_nodes)
+    seg = (safe_node[:, None] * d + jnp.arange(d, dtype=jnp.int32)[None, :]) * num_bins + bins
+    seg = jnp.where(active[:, None], seg, num_nodes * d * num_bins)
+    num_segments = num_nodes * d * num_bins + 1
+
+    flat_seg = seg.reshape(-1)
+    g_flat = jnp.broadcast_to(grad[:, None], (n, d)).reshape(-1)
+    h_flat = jnp.broadcast_to(hess[:, None], (n, d)).reshape(-1)
+    G = jax.ops.segment_sum(g_flat, flat_seg, num_segments=num_segments)
+    H = jax.ops.segment_sum(h_flat, flat_seg, num_segments=num_segments)
+    G = G[:-1].reshape(num_nodes, d, num_bins)
+    H = H[:-1].reshape(num_nodes, d, num_bins)
+    if axis_name is not None:
+        G = jax.lax.psum(G, axis_name)
+        H = jax.lax.psum(H, axis_name)
+    return G, H

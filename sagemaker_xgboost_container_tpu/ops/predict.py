@@ -1,0 +1,68 @@
+"""Compiled forest inference kernel.
+
+The serving-side replacement for libxgboost's C++ predictor (reference hot
+loop: serve_utils.py:244-250 ``booster.predict``). The whole forest is laid
+out as stacked per-tree node arrays in HBM; traversal is ``depth`` rounds of
+vectorized gather/compare over [rows x trees] — no per-tree Python, one XLA
+program, jit-cached per (num_rows bucket, forest version).
+
+Works on explicit child indices (not the padded full-binary layout) so
+imported xgboost-JSON models of any shape run through the same kernel.
+Missing values (NaN) follow ``default_left``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _forest_margin(feature, threshold, default_left, left, right, is_leaf, leaf_value, x, depth):
+    """x: f32 [n, d] (NaN = missing) -> per-tree-group margins [n].
+
+    Tree arrays: [T, N] stacked; leaves self-loop via left/right == own index.
+    """
+    n = x.shape[0]
+    T = feature.shape[0]
+    node = jnp.zeros((n, T), jnp.int32)
+    t_idx = jnp.arange(T)[None, :]
+
+    for _ in range(depth):
+        feat = feature[t_idx, node]            # [n, T]
+        thr = threshold[t_idx, node]
+        v = jnp.take_along_axis(x, feat.reshape(n, -1), axis=1).reshape(n, T)
+        miss = jnp.isnan(v)
+        go_right = jnp.where(miss, ~default_left[t_idx, node], v >= thr)
+        nxt = jnp.where(go_right, right[t_idx, node], left[t_idx, node])
+        node = jnp.where(is_leaf[t_idx, node], node, nxt)
+    return leaf_value[t_idx, node]             # [n, T]
+
+
+def forest_predict_margin(stacked, x, num_output_group=1, base_margin=0.0, tree_info=None):
+    """Sum per-tree leaf outputs into per-group margins.
+
+    stacked: dict of [T, N] numpy/jnp arrays + "depth" int.
+    Returns [n] (single group) or [n, num_output_group].
+    """
+    leaf = _forest_margin(
+        stacked["feature"],
+        stacked["threshold"],
+        stacked["default_left"],
+        stacked["left"],
+        stacked["right"],
+        stacked["is_leaf"],
+        stacked["leaf_value"],
+        jnp.asarray(x, jnp.float32),
+        stacked["depth"],
+    )
+    if num_output_group == 1:
+        return np.asarray(leaf.sum(axis=1)) + base_margin
+    # group trees by class id (tree_info) — static host-side partition
+    out = np.zeros((x.shape[0], num_output_group), np.float32)
+    leaf_np = np.asarray(leaf)
+    info = np.asarray(tree_info)
+    for c in range(num_output_group):
+        out[:, c] = leaf_np[:, info == c].sum(axis=1) + base_margin
+    return out

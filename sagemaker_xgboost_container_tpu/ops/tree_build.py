@@ -1,0 +1,154 @@
+"""Level-wise tree growth: one whole tree as a single XLA program.
+
+Replaces libxgboost's depthwise hist updater. Shapes are fully static: a tree
+with ``max_depth`` grows into a padded full-binary layout of
+``2**(max_depth+1) - 1`` node slots (children of i at 2i+1 / 2i+2), with the
+level loop unrolled in Python (max_depth is a compile-time constant), so XLA
+sees straight-line code of segment-sums, scans, and gathers — no
+data-dependent control flow (SURVEY.md §7 "static shapes" risk).
+
+Per level: histogram -> (psum over the data axis when distributed) -> split
+scan -> finalize leaves -> route rows to children. Rows carry their node id;
+finalized rows hold -1 and accumulate their leaf value into ``row_out``, so
+the booster updates margins without re-predicting the train set.
+"""
+
+from functools import partial
+
+import jax.numpy as jnp
+
+from .histogram import level_histogram
+from .split import find_best_splits, leaf_weight
+
+MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
+
+
+def max_nodes_for_depth(max_depth):
+    return 2 ** (max_depth + 1) - 1
+
+
+def build_tree(
+    bins,
+    grad,
+    hess,
+    num_cuts,
+    max_depth,
+    num_bins,
+    reg_lambda=1.0,
+    alpha=0.0,
+    gamma=0.0,
+    min_child_weight=1.0,
+    eta=0.3,
+    max_delta_step=0.0,
+    feature_mask=None,
+    monotone=None,
+    axis_name=None,
+):
+    """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
+
+    Tree arrays (length ``max_nodes_for_depth(max_depth)``):
+      feature, bin (i32), default_left (bool), is_leaf (bool),
+      leaf_value (f32, eta already applied), base_weight (f32, pre-eta),
+      gain (f32), sum_hess (f32).
+    """
+    n, d = bins.shape
+    max_nodes = max_nodes_for_depth(max_depth)
+    bins = bins.astype(jnp.int32)
+
+    tree = {
+        "feature": jnp.zeros(max_nodes, jnp.int32),
+        "bin": jnp.zeros(max_nodes, jnp.int32),
+        "default_left": jnp.zeros(max_nodes, jnp.bool_),
+        "is_leaf": jnp.zeros(max_nodes, jnp.bool_),
+        "leaf_value": jnp.zeros(max_nodes, jnp.float32),
+        "base_weight": jnp.zeros(max_nodes, jnp.float32),
+        "gain": jnp.zeros(max_nodes, jnp.float32),
+        "sum_hess": jnp.zeros(max_nodes, jnp.float32),
+    }
+
+    node_of_row = jnp.zeros(n, jnp.int32)
+    row_out = jnp.zeros(n, jnp.float32)
+
+    for level in range(max_depth + 1):
+        first = 2**level - 1
+        width = 2**level
+        node_local = node_of_row - first  # negative for finalized rows
+
+        G, H = level_histogram(
+            bins, grad, hess, node_local, width, num_bins, axis_name=axis_name
+        )
+        splits = find_best_splits(
+            G,
+            H,
+            num_cuts,
+            reg_lambda=reg_lambda,
+            alpha=alpha,
+            gamma=gamma,
+            min_child_weight=min_child_weight,
+            feature_mask=feature_mask,
+            monotone=monotone,
+        )
+        g_tot, h_tot = splits["g_total"], splits["h_total"]
+        weight = leaf_weight(
+            g_tot, h_tot, reg_lambda=reg_lambda, alpha=alpha, max_delta_step=max_delta_step
+        )
+
+        if level == max_depth:
+            can_split = jnp.zeros(width, jnp.bool_)
+        else:
+            can_split = splits["gain"] > MIN_SPLIT_LOSS
+        becomes_leaf = ~can_split
+
+        sl = slice(first, first + width)
+        tree["feature"] = tree["feature"].at[sl].set(splits["feature"])
+        tree["bin"] = tree["bin"].at[sl].set(splits["bin"])
+        tree["default_left"] = tree["default_left"].at[sl].set(splits["default_left"])
+        tree["is_leaf"] = tree["is_leaf"].at[sl].set(becomes_leaf)
+        tree["leaf_value"] = tree["leaf_value"].at[sl].set(
+            jnp.where(becomes_leaf, eta * weight, 0.0)
+        )
+        tree["base_weight"] = tree["base_weight"].at[sl].set(weight)
+        tree["gain"] = tree["gain"].at[sl].set(
+            jnp.where(can_split, splits["gain"], 0.0)
+        )
+        tree["sum_hess"] = tree["sum_hess"].at[sl].set(h_tot)
+
+        # --- route rows ----------------------------------------------------
+        at_level = node_local >= 0
+        local_safe = jnp.clip(node_local, 0, width - 1)
+        row_leafed = at_level & becomes_leaf[local_safe]
+        row_out = jnp.where(row_leafed, eta * weight[local_safe], row_out)
+
+        split_feat = splits["feature"][local_safe]
+        split_bin = splits["bin"][local_safe]
+        row_bin = jnp.take_along_axis(bins, split_feat[:, None], axis=1)[:, 0]
+        is_missing = row_bin == (num_bins - 1)
+        go_right = jnp.where(
+            is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
+        )
+        child = node_of_row * 2 + 1 + go_right.astype(jnp.int32)
+        node_of_row = jnp.where(
+            row_leafed, -1, jnp.where(at_level, child, node_of_row)
+        )
+
+    return tree, row_out
+
+
+def predict_binned(tree, bins, max_depth, num_bins):
+    """Apply one trained (padded-layout) tree to binned rows -> margins.
+
+    Used for validation-set evaluation during training (validation is binned
+    with the training cuts, so bin comparison == float comparison).
+    """
+    n = bins.shape[0]
+    bins = bins.astype(jnp.int32)
+    node = jnp.zeros(n, jnp.int32)
+    for _ in range(max_depth):
+        feat = tree["feature"][node]
+        split_bin = tree["bin"][node]
+        row_bin = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
+        is_missing = row_bin == (num_bins - 1)
+        go_right = jnp.where(is_missing, ~tree["default_left"][node], row_bin > split_bin)
+        child = node * 2 + 1 + go_right.astype(jnp.int32)
+        node = jnp.where(tree["is_leaf"][node], node, child)
+    return tree["leaf_value"][node]

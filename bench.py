@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Benchmark: boosting rounds/sec of the XLA histogram tree builder.
+
+Measures steady-state boosting throughput on a synthetic Higgs-like binary
+classification task (BASELINE.md config #2: dense numeric features,
+binary:logistic, hist). Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "rounds/sec", "vs_baseline": N}
+
+vs_baseline is measured against the north-star target of 5 boosting
+rounds/sec (BASELINE.json) — the reference publishes no numbers of its own
+(BASELINE.md: published = {}).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+N_ROWS = int(os.getenv("BENCH_ROWS", "1000000"))
+N_FEATURES = int(os.getenv("BENCH_FEATURES", "28"))
+MAX_DEPTH = int(os.getenv("BENCH_MAX_DEPTH", "8"))
+WARMUP_ROUNDS = 3
+BENCH_ROUNDS = int(os.getenv("BENCH_ROUNDS_N", "20"))
+NORTH_STAR_ROUNDS_PER_SEC = 5.0
+
+
+def _make_data(n, d, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    logit = X[:, 0] * 0.8 + X[:, 1] * X[:, 2] * 0.5 + np.sin(X[:, 3]) - 0.2
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models.booster import (
+        TrainConfig,
+        _TrainingSession,
+    )
+    from sagemaker_xgboost_container_tpu.models.forest import Forest
+
+    X, y = _make_data(N_ROWS, N_FEATURES)
+    dtrain = DataMatrix(X, labels=y)
+    params = {
+        "objective": "binary:logistic",
+        "max_depth": MAX_DEPTH,
+        "eta": 0.2,
+        "tree_method": "hist",
+        "max_bin": 256,
+    }
+    config = TrainConfig(params)
+    forest = Forest(
+        objective_name=config.objective,
+        base_score=config.base_score,
+        num_feature=dtrain.num_col,
+    )
+    session = _TrainingSession(config, dtrain, [], forest)
+
+    for _ in range(WARMUP_ROUNDS):
+        session.run_round()
+
+    import jax
+
+    jax.block_until_ready(session.margins)
+    start = time.perf_counter()
+    for _ in range(BENCH_ROUNDS):
+        session.run_round()
+    jax.block_until_ready(session.margins)
+    elapsed = time.perf_counter() - start
+
+    rounds_per_sec = BENCH_ROUNDS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "boosting rounds/sec (synthetic Higgs-like, {} rows x {} feat, depth {}, binary:logistic)".format(
+                    N_ROWS, N_FEATURES, MAX_DEPTH
+                ),
+                "value": round(rounds_per_sec, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(rounds_per_sec / NORTH_STAR_ROUNDS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

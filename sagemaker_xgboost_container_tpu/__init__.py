@@ -7,3 +7,14 @@ sharded across a TPU mesh instead of libxgboost + Rabit/NCCL.
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Site plugins (e.g. a PJRT tunnel) may force jax_platforms after env
+    # parsing; an explicit JAX_PLATFORMS=cpu from the user must win (tests,
+    # virtual-mesh dry runs).
+    import jax as _jax
+
+    if _jax.config.jax_platforms != "cpu":
+        _jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,1 @@
+from .custom_metrics import CUSTOM_METRICS, configure_feval, get_custom_metrics  # noqa: F401

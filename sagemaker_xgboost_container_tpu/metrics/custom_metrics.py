@@ -1,0 +1,103 @@
+"""sklearn-backed "custom" metrics exposed as a feval during training.
+
+Same split as the reference (metrics/custom_metrics.py:233-280): metrics the
+booster doesn't implement natively ride the feval channel and are printed in
+the same stdout line as native metrics, so the HPO regex contract covers them
+uniformly. Per the xgboost >= 1.2 convention, the feval receives the *raw
+margin* (log-odds for binary, [n, C] margins for multiclass) and converts to
+class labels itself (reference :38-44).
+
+Order stability matters for distributed training: the configured metric list
+is preserved as given; callers pass a sorted union (train_utils.py).
+"""
+
+import numpy as np
+from sklearn.metrics import (
+    accuracy_score,
+    balanced_accuracy_score,
+    f1_score,
+    mean_absolute_error,
+    mean_squared_error,
+    precision_score,
+    r2_score,
+    recall_score,
+)
+
+from ..constants import MULTI_CLASS_F1_BINARY_ERROR
+from ..toolkit import exceptions as exc
+
+
+def sigmoid(x):
+    """Margin -> probability; tanh form is stable for large |x|."""
+    return 0.5 * (1 + np.tanh(0.5 * x))
+
+
+def margin_to_class_label(preds):
+    """Raw margin -> class label (argmax for multiclass, >0 for binary)."""
+    preds = np.asarray(preds)
+    if preds.ndim > 1:
+        return np.argmax(preds, axis=-1)
+    return (preds > 0.0).astype(int)
+
+
+def _classification(metricfunc, check_binary=False):
+    def compute(preds, dtrain):
+        if np.asarray(preds).size == 0:
+            return 0.0
+        labels = dtrain.get_label()
+        pred_labels = margin_to_class_label(preds)
+        if check_binary and len(np.unique(labels)) > 2:
+            raise exc.UserError(MULTI_CLASS_F1_BINARY_ERROR)
+        return float(metricfunc(labels, pred_labels))
+
+    return compute
+
+
+def _regression(metricfunc):
+    def compute(preds, dtrain):
+        return float(metricfunc(dtrain.get_label(), np.asarray(preds)))
+
+    return compute
+
+
+CUSTOM_METRICS = {
+    "accuracy": _classification(accuracy_score),
+    "balanced_accuracy": _classification(balanced_accuracy_score),
+    "f1": _classification(lambda y, p: f1_score(y, p, average="macro")),
+    "f1_binary": _classification(
+        lambda y, p: f1_score(y, p, average="binary"), check_binary=True
+    ),
+    "f1_macro": _classification(lambda y, p: f1_score(y, p, average="macro")),
+    "mse": _regression(mean_squared_error),
+    "rmse": _regression(lambda y, p: float(np.sqrt(mean_squared_error(y, p)))),
+    "mae": _regression(mean_absolute_error),
+    "precision": _classification(precision_score),
+    "precision_macro": _classification(
+        lambda y, p: precision_score(y, p, average="macro")
+    ),
+    "precision_micro": _classification(
+        lambda y, p: precision_score(y, p, average="micro")
+    ),
+    "r2": _regression(r2_score),
+    "recall": _classification(recall_score),
+    "recall_macro": _classification(lambda y, p: recall_score(y, p, average="macro")),
+    "recall_micro": _classification(lambda y, p: recall_score(y, p, average="micro")),
+}
+
+
+def get_custom_metrics(eval_metrics):
+    """Subset of the requested metrics that we must compute via feval.
+
+    Keeps input order — it must be consistent across hosts (reference
+    custom_metrics.py:252-258).
+    """
+    return [m for m in eval_metrics if m in CUSTOM_METRICS]
+
+
+def configure_feval(custom_metric_list):
+    """Build the feval callable: (margin, dtrain) -> [(name, value), ...]."""
+
+    def custom_feval(preds, dtrain):
+        return [(name, CUSTOM_METRICS[name](preds, dtrain)) for name in custom_metric_list]
+
+    return custom_feval

@@ -6,11 +6,15 @@ level-wise tree build (ops/tree_build) -> margin updates for train and every
 eval set — the only host work per round is pulling the tree's small node
 arrays (O(2^max_depth)) for the Forest and the eval scalars for callbacks.
 
-Distribution: when a mesh is supplied, rows are sharded over the "data" axis
-with ``shard_map``; the single ``lax.psum`` inside the histogram op is the
-entire cross-host protocol (replacing Rabit allreduce + tracker topology,
-SURVEY.md §5). Trees come out bitwise identical on every shard, so the
-"master saves the model" contract is trivially consistent.
+Distribution: with a mesh, every round runs under ``shard_map`` with rows
+sharded over the "data" axis; the single ``lax.psum`` inside the histogram op
+is the entire cross-host protocol (replacing Rabit allreduce + tracker
+topology — SURVEY.md §5). Trees come out bitwise identical on every shard, so
+the "master saves the model" contract is trivially consistent. Rows are
+zero-weight padded to a multiple of the shard count.
+
+Ranking objectives route through ops/ranking's LambdaMART gradients over a
+padded [groups, max_group] layout.
 
 Callback protocol mirrors xgboost's (before_training / after_iteration ->
 bool stop / after_training) so the orchestration layer's checkpoint, early
@@ -23,13 +27,20 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..data.binning import bin_matrix
-from ..ops.tree_build import build_tree, max_nodes_for_depth, predict_binned
+from ..ops.ranking import build_group_layout, lambdarank_grad_hess
+from ..ops.tree_build import build_tree, predict_binned
 from ..toolkit import exceptions as exc
 from . import eval_metrics
 from . import objectives as objectives_mod
 from .forest import Forest, compact_padded_tree
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 logger = logging.getLogger(__name__)
 
@@ -40,7 +51,14 @@ class TrainConfig:
     def __init__(self, params):
         p = dict(params or {})
         self.eta = float(p.get("eta", 0.3))
-        self.max_depth = int(p.get("max_depth", 6) or 6)
+        max_depth = p.get("max_depth", 6)
+        self.max_depth = int(max_depth) if max_depth is not None else 6
+        if self.max_depth == 0:
+            raise exc.UserError(
+                "max_depth=0 (unlimited depth) is not supported by the TPU static-shape "
+                "tree builder; set max_depth >= 1 (or use grow_policy=lossguide with "
+                "max_leaves in a future release)."
+            )
         self.reg_lambda = float(p.get("lambda", 1.0))
         self.alpha = float(p.get("alpha", 0.0))
         self.gamma = float(p.get("gamma", 0.0))
@@ -65,6 +83,11 @@ class TrainConfig:
             raise exc.UserError(
                 "tree_method 'gpu_hist' is not available in the TPU container; use 'hist'."
             )
+        if self.num_parallel_tree > 1 and self.num_class > 1:
+            raise exc.UserError(
+                "num_parallel_tree > 1 combined with multi-class objectives is not "
+                "supported yet."
+            )
 
 
 def _eval_metric_names(config, objective):
@@ -76,6 +99,14 @@ def _eval_metric_names(config, objective):
     return list(metrics)
 
 
+def _pad_rows(array, target_rows, fill):
+    n = array.shape[0]
+    if n == target_rows:
+        return array
+    pad_shape = (target_rows - n,) + array.shape[1:]
+    return np.concatenate([array, np.full(pad_shape, fill, array.dtype)], axis=0)
+
+
 class _TrainingSession:
     """Device state for one training run (bins, margins, jitted round fns)."""
 
@@ -84,15 +115,30 @@ class _TrainingSession:
         self.objective = forest.objective()
         self.num_group = self.objective.num_output_group
         self.mesh = mesh
+        self.n_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
 
         labels = dtrain.labels
         self.objective.validate_labels(labels)
 
+        self.is_ranking = getattr(self.objective, "needs_groups", False)
+        if self.is_ranking and mesh is not None:
+            raise exc.UserError(
+                "Distributed training for ranking objectives is not supported yet; "
+                "run ranking jobs on a single host."
+            )
+        if self.is_ranking:
+            if dtrain.groups is None:
+                # xgboost convention: absent group info = one group per dataset
+                groups = np.asarray([dtrain.num_row], np.int64)
+            else:
+                groups = dtrain.groups
+            self.row_index = jnp.asarray(build_group_layout(groups))
+        else:
+            self.row_index = None
+
         self.train_binned = bin_matrix(dtrain, config.max_bin)
         self.cuts = self.train_binned.cut_points
-        self.num_cuts = jnp.asarray(
-            np.array([len(c) for c in self.cuts], np.int32)
-        )
+        self.num_cuts = jnp.asarray(np.array([len(c) for c in self.cuts], np.int32))
         self.eval_sets = []
         for dm, name in evals:
             binned = (
@@ -102,48 +148,80 @@ class _TrainingSession:
             )
             self.eval_sets.append((name, dm, binned))
 
-        n = dtrain.num_row
-        self.n = n
-        self.bins = jnp.asarray(self.train_binned.bins)
-        self.labels = jnp.asarray(labels)
-        self.weights = jnp.asarray(dtrain.get_weight())
+        self.n = dtrain.num_row
+        n_pad = -(-self.n // self.n_shards) * self.n_shards
+
+        bins_np = _pad_rows(self.train_binned.bins, n_pad, self.train_binned.max_bin)
+        self.bins = jnp.asarray(bins_np)
+        self.labels = jnp.asarray(_pad_rows(labels, n_pad, 0.0))
+        self.weights = jnp.asarray(_pad_rows(dtrain.get_weight(), n_pad, 0.0))
         self.groups = dtrain.groups
+
         base = self.objective.base_margin(forest.base_score)
-        shape = (n,) if self.num_group == 1 else (n, self.num_group)
+        shape = (n_pad,) if self.num_group == 1 else (n_pad, self.num_group)
         if forest.trees:
-            # resume: margins from the existing forest
-            margin = forest.predict_margin(dtrain.features)
-            self.margins = jnp.asarray(margin.reshape(shape))
+            margin = forest.predict_margin(dtrain.features).reshape(
+                (self.n,) if self.num_group == 1 else (self.n, self.num_group)
+            )
+            self.margins = jnp.asarray(_pad_rows(margin, n_pad, base))
         else:
             self.margins = jnp.full(shape, base, jnp.float32)
+
+        # eval-set device state: bins cached once, margins incremental
+        self.eval_bins = []
         self.eval_margins = []
         for name, dm, binned in self.eval_sets:
-            eshape = (dm.num_row,) if self.num_group == 1 else (dm.num_row, self.num_group)
             if binned is self.train_binned:
-                self.eval_margins.append(None)  # shares training margins
-            elif forest.trees:
-                self.eval_margins.append(
-                    jnp.asarray(forest.predict_margin(dm.features).reshape(eshape))
+                self.eval_bins.append(None)     # shares training margins
+                self.eval_margins.append(None)
+                continue
+            m_pad = -(-dm.num_row // self.n_shards) * self.n_shards
+            self.eval_bins.append(
+                jnp.asarray(_pad_rows(binned.bins, m_pad, binned.max_bin))
+            )
+            eshape = (m_pad,) if self.num_group == 1 else (m_pad, self.num_group)
+            if forest.trees:
+                em = forest.predict_margin(dm.features).reshape(
+                    (dm.num_row,) if self.num_group == 1 else (dm.num_row, self.num_group)
                 )
+                self.eval_margins.append(jnp.asarray(_pad_rows(em, m_pad, base)))
             else:
                 self.eval_margins.append(jnp.full(eshape, base, jnp.float32))
+
         self.rng = jax.random.PRNGKey(config.seed)
 
-        monotone = None
+        monotone = np.zeros(dtrain.num_col, np.int32)
         if config.monotone_constraints:
-            mono = np.zeros(dtrain.num_col, np.int32)
-            vals = config.monotone_constraints
-            mono[: len(vals)] = np.asarray(vals, np.int32)
-            monotone = jnp.asarray(mono)
-        self.monotone = monotone
+            vals = np.asarray(config.monotone_constraints, np.int32)
+            monotone[: len(vals)] = vals
+        self.monotone = jnp.asarray(monotone)
+        self.has_monotone = bool(config.monotone_constraints)
 
         self._round_fn = self._make_round_fn()
         self._apply_fn = self._make_apply_fn()
 
     # ------------------------------------------------------------------ jit
+    def _grad_hess_fn(self):
+        if not self.is_ranking:
+            return None
+        scheme = self.objective.scheme
+        row_index = self.row_index
+
+        def ranking_grads(margins, labels, weights):
+            return lambdarank_grad_hess(
+                margins, labels, weights, row_index, scheme=scheme
+            )
+
+        return ranking_grads
+
     def _make_round_fn(self):
         cfg = self.config
         num_bins = self.train_binned.num_bins
+        axis_name = "data" if self.mesh is not None else None
+        # With num_parallel_tree=K, all K trees of a round fit the *same*
+        # gradients (a bagged forest step), so their summed corrections are
+        # averaged via eta/K — otherwise the round overshoots by K.
+        effective_eta = cfg.eta / cfg.num_parallel_tree
         builder = partial(
             build_tree,
             max_depth=cfg.max_depth,
@@ -152,53 +230,120 @@ class _TrainingSession:
             alpha=cfg.alpha,
             gamma=cfg.gamma,
             min_child_weight=cfg.min_child_weight,
-            eta=cfg.eta,
+            eta=effective_eta,
             max_delta_step=cfg.max_delta_step,
+            colsample_bylevel=cfg.colsample_bylevel,
+            axis_name=axis_name,
         )
+        ranking_grads = self._grad_hess_fn()
         grad_hess = self.objective.grad_hess
         num_group = self.num_group
         subsample = cfg.subsample
+        num_parallel = cfg.num_parallel_tree
+        use_monotone = self.has_monotone
 
         def one_round(bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone):
-            g, h = grad_hess(margins, labels, weights)
-            if subsample < 1.0:
-                keep = (
-                    jax.random.uniform(rng, (bins.shape[0],)) < subsample
-                ).astype(jnp.float32)
-                if num_group == 1:
-                    g, h = g * keep, h * keep
-                else:
-                    g, h = g * keep[:, None], h * keep[:, None]
-            if num_group == 1:
-                tree, row_out = builder(
-                    bins, g, h, num_cuts, feature_mask=feature_mask, monotone=monotone
-                )
-                margins = margins + row_out
+            mono = monotone if use_monotone else None
+            if axis_name is not None:
+                # decorrelate per-shard subsample draws
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+            if ranking_grads is not None:
+                g, h = ranking_grads(margins, labels, weights)
             else:
+                g, h = grad_hess(margins, labels, weights)
+
+            def sampled(rng_k, gc, hc):
+                if subsample >= 1.0:
+                    return gc, hc
+                keep = (
+                    jax.random.uniform(rng_k, (bins.shape[0],)) < subsample
+                ).astype(jnp.float32)
+                if gc.ndim == 1:
+                    return gc * keep, hc * keep
+                return gc * keep[:, None], hc * keep[:, None]
+
+            trees = []
+            if num_group == 1:
+                total_out = jnp.zeros_like(margins)
+                for k in range(num_parallel):
+                    rng_k = jax.random.fold_in(rng, k)
+                    gk, hk = sampled(rng_k, g, h)
+                    tree, row_out = builder(
+                        bins, gk, hk, num_cuts,
+                        feature_mask=feature_mask, monotone=mono, rng=rng_k,
+                    )
+                    trees.append(tree)
+                    total_out = total_out + row_out
+                margins = margins + total_out
+            else:
+                rng_k = jax.random.fold_in(rng, 0)
+                g, h = sampled(rng_k, g, h)
                 tree, row_out = jax.vmap(
                     lambda gc, hc: builder(
-                        bins, gc, hc, num_cuts, feature_mask=feature_mask, monotone=monotone
+                        bins, gc, hc, num_cuts,
+                        feature_mask=feature_mask, monotone=mono, rng=rng_k,
                     )
                 )(g.T, h.T)
+                trees.append(tree)
                 margins = margins + row_out.T
-            return tree, margins
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(leaves), *trees
+            ) if num_parallel > 1 else trees[0]
+            return stacked, margins
 
-        return jax.jit(one_round, donate_argnums=(1,))
+        if self.mesh is None:
+            return jax.jit(one_round, donate_argnums=(1,))
+
+        margin_spec = P("data") if num_group == 1 else P("data", None)
+        mapped = shard_map(
+            one_round,
+            mesh=self.mesh,
+            in_specs=(
+                P("data", None),   # bins
+                margin_spec,       # margins
+                P("data"),         # labels
+                P("data"),         # weights
+                P(),               # num_cuts
+                P(),               # rng
+                P(),               # feature_mask
+                P(),               # monotone
+            ),
+            out_specs=(P(), margin_spec),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(1,))
 
     def _make_apply_fn(self):
         cfg = self.config
         num_bins = self.train_binned.num_bins
         num_group = self.num_group
+        num_parallel = cfg.num_parallel_tree
 
         def apply_tree(tree, bins, margins):
             if num_group == 1:
-                return margins + predict_binned(tree, bins, cfg.max_depth, num_bins)
+                if num_parallel > 1:
+                    delta = jax.vmap(
+                        lambda t: predict_binned(t, bins, cfg.max_depth, num_bins)
+                    )(tree).sum(axis=0)
+                else:
+                    delta = predict_binned(tree, bins, cfg.max_depth, num_bins)
+                return margins + delta
             deltas = jax.vmap(
                 lambda t: predict_binned(t, bins, cfg.max_depth, num_bins)
             )(tree)
             return margins + deltas.T
 
-        return jax.jit(apply_tree, donate_argnums=(2,))
+        if self.mesh is None:
+            return jax.jit(apply_tree, donate_argnums=(2,))
+        margin_spec = P("data") if num_group == 1 else P("data", None)
+        mapped = shard_map(
+            apply_tree,
+            mesh=self.mesh,
+            in_specs=(P(), P("data", None), margin_spec),
+            out_specs=margin_spec,
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(2,))
 
     # ---------------------------------------------------------------- round
     def run_round(self):
@@ -209,7 +354,7 @@ class _TrainingSession:
             chosen = jax.random.permutation(colrng, d)[:k]
             feature_mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
         else:
-            feature_mask = None
+            feature_mask = jnp.ones(d, jnp.float32)
         tree, self.margins = self._round_fn(
             self.bins,
             self.margins,
@@ -220,17 +365,20 @@ class _TrainingSession:
             feature_mask,
             self.monotone,
         )
-        for i, (name, dm, binned) in enumerate(self.eval_sets):
+        for i in range(len(self.eval_sets)):
             if self.eval_margins[i] is not None:
                 self.eval_margins[i] = self._apply_fn(
-                    tree, jnp.asarray(binned.bins), self.eval_margins[i]
+                    tree, self.eval_bins[i], self.eval_margins[i]
                 )
         return jax.tree_util.tree_map(np.asarray, tree)
 
     # ----------------------------------------------------------------- eval
     def margins_for(self, index):
+        dm = self.eval_sets[index][1]
         m = self.eval_margins[index]
-        return np.asarray(self.margins if m is None else m)
+        if m is None:
+            return np.asarray(self.margins)[: self.n]
+        return np.asarray(m)[: dm.num_row]
 
     def evaluate(self, metric_names, feval=None):
         """Returns list of (data_name, metric_name, value) per eval set."""
@@ -240,12 +388,13 @@ class _TrainingSession:
             preds = self.objective.margin_to_prediction(margin)
             prob_matrix = None
             if self.num_group > 1:
-                e = np.exp(margin - margin.max(axis=1, keepdims=True))
-                prob_matrix = e / e.sum(axis=1, keepdims=True)
+                prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
+                    self.objective, margin
+                )
             for metric in metric_names:
                 value = eval_metrics.evaluate(
                     metric,
-                    preds if preds.ndim == 1 else preds,
+                    preds,
                     dm.labels,
                     dm.weights,
                     groups=dm.groups,
@@ -253,7 +402,8 @@ class _TrainingSession:
                 )
                 results.append((name, metric, value))
             if feval is not None:
-                for metric_name, value in feval(preds, dm, margin=margin):
+                # xgboost >= 1.2 convention: feval receives the raw margin
+                for metric_name, value in feval(margin, dm):
                     results.append((name, metric_name, value))
         return results
 
@@ -273,6 +423,7 @@ def train(
 
     xgb_model: a Forest or a model-file path to continue training from
     (checkpoint resume — reference checkpointing.py:45-55).
+    mesh: optional jax Mesh with a "data" axis for multi-chip data parallelism.
     """
     config = TrainConfig(params)
     callbacks = list(callbacks or [])
@@ -317,17 +468,27 @@ def train(
     stop = False
     for rnd in range(start_round, start_round + num_boost_round):
         tree_np = session.run_round()
-        if session.num_group == 1:
-            trees = [compact_padded_tree(tree_np, session.cuts)]
-            info = [0]
-        else:
-            trees = [
-                compact_padded_tree(
-                    {k: v[c] for k, v in tree_np.items()}, session.cuts
+
+        def _trees_for_round(arrs):
+            if session.num_group > 1:
+                return (
+                    [
+                        compact_padded_tree({k: v[c] for k, v in arrs.items()}, session.cuts)
+                        for c in range(session.num_group)
+                    ],
+                    list(range(session.num_group)),
                 )
-                for c in range(session.num_group)
-            ]
-            info = list(range(session.num_group))
+            if config.num_parallel_tree > 1:
+                return (
+                    [
+                        compact_padded_tree({k: v[t] for k, v in arrs.items()}, session.cuts)
+                        for t in range(config.num_parallel_tree)
+                    ],
+                    [0] * config.num_parallel_tree,
+                )
+            return [compact_padded_tree(arrs, session.cuts)], [0]
+
+        trees, info = _trees_for_round(tree_np)
         forest.append_round(trees, info)
 
         results = session.evaluate(metric_names, feval=feval) if session.eval_sets else []

@@ -1,0 +1,351 @@
+"""Foreign model-format loaders: xgboost UBJSON, legacy binary, pickles.
+
+The serving contract requires loading models produced by real xgboost
+(reference serve_utils.py:171-197 loads pickle-or-native): customers bring
+``xgboost-model`` files saved as
+
+* xgboost JSON (handled by Forest.load_json directly),
+* xgboost UBJSON (draft-12 UBJ encoding of the same document — the default
+  ``save_model`` format since xgboost 2.x),
+* the legacy binary format (pre-1.0 ``deprecated`` format: packed C structs),
+* Python pickles of ``xgboost.core.Booster`` — unpickled via a stub module
+  (no xgboost import in this image), whose ``handle`` buffer embeds either
+  the legacy binary + a ``CONFIG-offset:`` JSON trailer, UBJ, or JSON.
+
+All paths land in our Forest, so every model runs on the XLA predict kernel.
+"""
+
+import io
+import json
+import pickle
+import struct
+import sys
+import types
+
+import numpy as np
+
+from ..toolkit import exceptions as exc
+from .forest import Forest, Tree
+
+PKL_FORMAT = "pkl_format"
+XGB_FORMAT = "xgb_format"
+
+
+# ---------------------------------------------------------------------------
+# UBJSON (draft-12, the subset xgboost emits)
+# ---------------------------------------------------------------------------
+
+# UBJSON numbers are big-endian (draft-12 spec)
+_UBJ_INT_TYPES = {
+    b"i": ("b", 1),
+    b"U": ("B", 1),
+    b"I": (">h", 2),
+    b"u": (">H", 2),
+    b"l": (">i", 4),
+    b"m": (">I", 4),
+    b"L": (">q", 8),
+    b"M": (">Q", 8),
+}
+_UBJ_FLOAT_TYPES = {b"d": (">f", 4), b"D": (">d", 8)}
+
+
+class _UbjReader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n):
+        out = self.buf[self.pos : self.pos + n]
+        if len(out) != n:
+            raise ValueError("truncated UBJSON")
+        self.pos += n
+        return out
+
+    def peek(self):
+        return self.buf[self.pos : self.pos + 1]
+
+    def read_marker(self):
+        marker = self.take(1)
+        while marker == b"N":  # no-op
+            marker = self.take(1)
+        return marker
+
+    def read_int(self, marker=None):
+        marker = marker or self.read_marker()
+        spec = _UBJ_INT_TYPES.get(marker)
+        if spec is None:
+            raise ValueError("expected UBJ int, got {!r}".format(marker))
+        fmt, size = spec
+        return struct.unpack(fmt, self.take(size))[0]
+
+    def read_string(self):
+        return self.take(self.read_int()).decode("utf-8")
+
+    def read_value(self, marker=None):
+        marker = marker or self.read_marker()
+        if marker in _UBJ_INT_TYPES:
+            fmt, size = _UBJ_INT_TYPES[marker]
+            return struct.unpack(fmt, self.take(size))[0]
+        if marker in _UBJ_FLOAT_TYPES:
+            fmt, size = _UBJ_FLOAT_TYPES[marker]
+            return struct.unpack(fmt, self.take(size))[0]
+        if marker == b"S":
+            return self.read_string()
+        if marker == b"C":
+            return self.take(1).decode("latin-1")
+        if marker == b"T":
+            return True
+        if marker == b"F":
+            return False
+        if marker == b"Z":
+            return None
+        if marker == b"[":
+            return self._read_array()
+        if marker == b"{":
+            return self._read_object()
+        raise ValueError("unsupported UBJ marker {!r}".format(marker))
+
+    def _read_array(self):
+        el_type = None
+        count = None
+        if self.peek() == b"$":
+            self.take(1)
+            el_type = self.take(1)
+        if self.peek() == b"#":
+            self.take(1)
+            count = self.read_int()
+        if el_type is not None and count is not None:
+            if el_type in _UBJ_INT_TYPES or el_type in _UBJ_FLOAT_TYPES:
+                fmt, size = (_UBJ_INT_TYPES.get(el_type) or _UBJ_FLOAT_TYPES[el_type])
+                raw = self.take(size * count)
+                dtype = {
+                    b"i": "b", b"U": "B", b"I": ">i2", b"u": ">u2",
+                    b"l": ">i4", b"m": ">u4", b"L": ">i8", b"M": ">u8",
+                    b"d": ">f4", b"D": ">f8",
+                }[el_type]
+                return np.frombuffer(raw, dtype=np.dtype(dtype)).tolist()
+            return [self.read_value(el_type) for _ in range(count)]
+        out = []
+        if count is not None:
+            for _ in range(count):
+                out.append(self.read_value())
+            return out
+        while self.peek() != b"]":
+            out.append(self.read_value())
+        self.take(1)
+        return out
+
+    def _read_object(self):
+        count = None
+        if self.peek() == b"$":
+            raise ValueError("typed UBJ objects unsupported")
+        if self.peek() == b"#":
+            self.take(1)
+            count = self.read_int()
+        out = {}
+        if count is not None:
+            for _ in range(count):
+                key = self.read_string()
+                out[key] = self.read_value()
+            return out
+        while self.peek() != b"}":
+            key = self.read_string()
+            out[key] = self.read_value()
+        self.take(1)
+        return out
+
+
+def decode_ubjson(buf):
+    return _UbjReader(buf).read_value()
+
+
+# ---------------------------------------------------------------------------
+# Legacy binary model format (xgboost "deprecated" serialization)
+# ---------------------------------------------------------------------------
+
+
+def _parse_legacy_binary(buf):
+    """Packed-struct model reader. Layouts follow the published C structs:
+    LearnerModelParam (128B), GBTreeModelParam (160B), per-tree TreeParam
+    (148B) + Node(20B)*n + RTreeNodeStat(16B)*n.
+    """
+    r = io.BytesIO(buf)
+    if buf[:4] == b"binf":
+        r.read(4)
+    base_score, num_feature, num_class, contain_extra_attrs, contain_eval_metrics = (
+        struct.unpack("<fIiii", r.read(20))
+    )
+    r.read(116)  # major + minor + reserved[27] -> LearnerModelParam is 136 bytes
+    (len_obj,) = struct.unpack("<Q", r.read(8))
+    name_obj = r.read(len_obj).decode()
+    (len_gbm,) = struct.unpack("<Q", r.read(8))
+    name_gbm = r.read(len_gbm).decode()
+    if name_gbm not in ("gbtree", "dart"):
+        raise exc.UserError(
+            "Legacy binary model with booster '{}' is not supported".format(name_gbm)
+        )
+    num_trees, _roots, _feat, _pad = struct.unpack("<iiii", r.read(16))
+    (_pbuffer,) = struct.unpack("<q", r.read(8))
+    num_output_group, size_leaf_vector = struct.unpack("<ii", r.read(8))
+    r.read(128)  # reserved[32]
+
+    forest = Forest(
+        objective_name=name_obj,
+        base_score=base_score,
+        num_feature=int(num_feature),
+        num_class=max(0, int(num_class)),
+    )
+    trees = []
+    for _ in range(num_trees):
+        _roots2, num_nodes, _deleted, _maxd, _nfeat, _slv = struct.unpack(
+            "<iiiiii", r.read(24)
+        )
+        r.read(124)  # reserved[31]
+        node_raw = np.frombuffer(r.read(20 * num_nodes), dtype=np.uint8).reshape(
+            num_nodes, 20
+        )
+        parent = node_raw[:, 0:4].copy().view("<i4").ravel()
+        cleft = node_raw[:, 4:8].copy().view("<i4").ravel()
+        cright = node_raw[:, 8:12].copy().view("<i4").ravel()
+        sindex = node_raw[:, 12:16].copy().view("<u4").ravel()
+        info = node_raw[:, 16:20].copy().view("<f4").ravel()
+        stat_raw = np.frombuffer(r.read(16 * num_nodes), dtype=np.uint8).reshape(
+            num_nodes, 16
+        )
+        loss_chg = stat_raw[:, 0:4].copy().view("<f4").ravel()
+        sum_hess = stat_raw[:, 4:8].copy().view("<f4").ravel()
+        base_weight = stat_raw[:, 8:12].copy().view("<f4").ravel()
+
+        is_leaf = cleft == -1
+        feature = (sindex & 0x7FFFFFFF).astype(np.int32)
+        default_left = (sindex >> 31).astype(bool)
+        trees.append(
+            Tree(
+                feature=np.where(is_leaf, 0, feature),
+                threshold=np.where(is_leaf, 0.0, info),
+                default_left=default_left,
+                left=cleft,
+                right=cright,
+                value=np.where(is_leaf, info, 0.0),
+                base_weight=base_weight,
+                gain=loss_chg,
+                sum_hess=sum_hess,
+                parent=np.where(parent < 0, 2147483647, parent & 0x7FFFFFFF),
+            )
+        )
+    forest.trees = trees
+    if num_output_group <= 0:
+        # some writers leave GBTreeModelParam.num_output_group zero; fall back
+        # to the learner's num_class
+        num_output_group = max(1, num_class)
+    groups = max(1, num_output_group)
+    forest.tree_info = [i % groups for i in range(num_trees)]
+    per_round = groups
+    forest.iteration_indptr = list(range(0, num_trees + 1, per_round))
+    if forest.iteration_indptr[-1] != num_trees:
+        forest.iteration_indptr.append(num_trees)
+    if contain_extra_attrs:
+        try:
+            (count,) = struct.unpack("<Q", r.read(8))
+            for _ in range(count):
+                (klen,) = struct.unpack("<Q", r.read(8))
+                key = r.read(klen).decode()
+                (vlen,) = struct.unpack("<Q", r.read(8))
+                forest.attributes[key] = r.read(vlen).decode()
+        except (struct.error, UnicodeDecodeError):
+            pass
+    return forest
+
+
+# ---------------------------------------------------------------------------
+# Pickle stub
+# ---------------------------------------------------------------------------
+
+
+class _StubBooster:
+    """Unpickle target standing in for xgboost.core.Booster."""
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    def __reduce__(self):  # defensive: never re-pickle the stub
+        raise TypeError("stub booster cannot be pickled")
+
+
+def _install_xgboost_stub():
+    if "xgboost" in sys.modules:
+        return
+    xgb = types.ModuleType("xgboost")
+    core = types.ModuleType("xgboost.core")
+    sklearn_mod = types.ModuleType("xgboost.sklearn")
+    core.Booster = _StubBooster
+    xgb.Booster = _StubBooster
+    for cls_name in ("XGBRegressor", "XGBClassifier", "XGBRanker", "XGBModel"):
+        setattr(sklearn_mod, cls_name, type(cls_name, (_StubBooster,), {}))
+    xgb.core = core
+    xgb.sklearn = sklearn_mod
+    sys.modules["xgboost"] = xgb
+    sys.modules["xgboost.core"] = core
+    sys.modules["xgboost.sklearn"] = sklearn_mod
+
+
+def _forest_from_raw(raw):
+    """Dispatch a raw model buffer by magic."""
+    raw = bytes(raw)
+    if raw[:14] == b"CONFIG-offset:":
+        (offset,) = struct.unpack("<Q", raw[14:22])
+        body = raw[22:]
+        forest = _parse_legacy_binary(body[:offset])
+        try:
+            config = json.loads(body[offset:].decode("utf-8", errors="ignore") or "{}")
+            learner = config.get("learner", {})
+            obj_name = learner.get("objective", {}).get("name")
+            if obj_name:
+                forest.objective_name = obj_name
+        except ValueError:
+            pass
+        return forest
+    head = raw.lstrip()[:1]
+    if head == b"{" and raw[1:2] not in (b"L", b"l", b"i", b"U", b"I", b"#", b"$"):
+        return Forest.load_json(raw.decode("utf-8"))
+    if raw[:1] == b"{":
+        return Forest.from_dict(decode_ubjson(raw))
+    return _parse_legacy_binary(raw)
+
+
+def _forest_from_pickle(path):
+    _install_xgboost_stub()
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    state = getattr(obj, "__dict__", None)
+    if not state or "handle" not in state:
+        raise exc.UserError("Pickled object is not an xgboost Booster")
+    forest = _forest_from_raw(state["handle"])
+    if state.get("feature_names"):
+        forest.feature_names = list(state["feature_names"])
+    best_it = state.get("best_iteration")
+    if best_it is not None and not isinstance(best_it, (dict, list)):
+        try:
+            forest.attributes.setdefault("best_iteration", str(int(best_it)))
+        except (TypeError, ValueError):
+            pass
+    return forest
+
+
+def load_model_any_format(path):
+    """-> (Forest, source format tag). The reference's pickle-or-native probe
+    order (serve_utils.py:180-190): try pickle first, then native."""
+    try:
+        return _forest_from_pickle(path), PKL_FORMAT
+    except Exception:
+        pass
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        return _forest_from_raw(raw), XGB_FORMAT
+    except Exception as e:
+        raise RuntimeError(
+            "Model {} cannot be loaded as pickle, JSON, UBJSON, or legacy binary: {}".format(
+                path, e
+            )
+        )

@@ -127,6 +127,15 @@ def compact_padded_tree(padded, cut_points):
     return Tree(**out)
 
 
+def _parse_base_score(value):
+    """xgboost >= 2.x may store base_score as a vector literal '[5E-1]'."""
+    if isinstance(value, str):
+        value = value.strip()
+        if value.startswith("["):
+            value = value.strip("[]").split(",")[0]
+    return float(value)
+
+
 class Forest:
     """The model: trees + objective metadata + prediction entry points."""
 
@@ -344,6 +353,13 @@ class Forest:
     def load_json(cls, text):
         try:
             doc = json.loads(text)
+        except (ValueError, TypeError) as e:
+            raise exc.UserError("Not a valid xgboost JSON model", caused_by=e)
+        return cls.from_dict(doc)
+
+    @classmethod
+    def from_dict(cls, doc):
+        try:
             learner = doc["learner"]
             model = learner["gradient_booster"]["model"]
             lmp = learner["learner_model_param"]
@@ -357,7 +373,7 @@ class Forest:
         forest = cls(
             objective_name=objective["name"],
             objective_params=params,
-            base_score=float(lmp.get("base_score", 0.5)),
+            base_score=_parse_base_score(lmp.get("base_score", 0.5)),
             num_feature=int(lmp.get("num_feature", 0)),
             num_class=int(lmp.get("num_class", 0)),
             feature_names=learner.get("feature_names") or None,

@@ -279,10 +279,9 @@ class SoftprobMulti(SoftmaxMulti):
 class LambdaRankObjective(Objective):
     """rank:pairwise / rank:ndcg / rank:map — LambdaMART gradients.
 
-    Group structure arrives as a per-row group-id array; gradients are built
-    from *all intra-group pairs* via a bucketed O(n * max_group) formulation
-    in the booster (see booster._ranking_grad_hess). This class only carries
-    scheme metadata; the heavy lifting needs the group layout.
+    Gradients need the query-group layout, so the booster routes these through
+    ``ops.ranking.lambdarank_grad_hess`` over a padded [groups, max_group]
+    index built once per dataset. This class carries scheme metadata only.
     """
 
     name = "rank:pairwise"
@@ -298,7 +297,8 @@ class LambdaRankObjective(Objective):
 
     def grad_hess(self, margin, label, weight):
         raise exc.AlgorithmError(
-            "ranking objectives need group info; use booster's ranking path"
+            "ranking objectives need group info; the booster must route through "
+            "ops.ranking.lambdarank_grad_hess"
         )
 
 

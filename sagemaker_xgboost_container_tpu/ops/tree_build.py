@@ -15,6 +15,7 @@ the booster updates margins without re-predicting the train set.
 
 from functools import partial
 
+import jax
 import jax.numpy as jnp
 
 from .histogram import level_histogram
@@ -43,6 +44,8 @@ def build_tree(
     feature_mask=None,
     monotone=None,
     axis_name=None,
+    rng=None,
+    colsample_bylevel=1.0,
 ):
     """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
 
@@ -77,6 +80,13 @@ def build_tree(
         G, H = level_histogram(
             bins, grad, hess, node_local, width, num_bins, axis_name=axis_name
         )
+        level_mask = feature_mask
+        if colsample_bylevel < 1.0 and rng is not None:
+            # fresh feature subset per level; identical on all shards (rng is
+            # replicated) so the chosen split is identical everywhere
+            draw = jax.random.uniform(jax.random.fold_in(rng, level), (d,))
+            sampled = (draw < colsample_bylevel).astype(jnp.float32)
+            level_mask = sampled if level_mask is None else level_mask * sampled
         splits = find_best_splits(
             G,
             H,
@@ -85,7 +95,7 @@ def build_tree(
             alpha=alpha,
             gamma=gamma,
             min_child_weight=min_child_weight,
-            feature_mask=feature_mask,
+            feature_mask=level_mask,
             monotone=monotone,
         )
         g_tot, h_tot = splits["g_total"], splits["h_total"]

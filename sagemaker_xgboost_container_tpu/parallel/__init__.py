@@ -1,0 +1,1 @@
+from .distributed import Cluster, distributed_run, wait_hostname_resolution  # noqa: F401

@@ -1,0 +1,174 @@
+"""Multi-host cluster lifecycle: rendezvous, membership sync, clean exits.
+
+TPU-native re-design of the reference's Rabit lifecycle (distributed.py:42-263
++ the vendored tracker in dmlc_patch/tracker.py). What survives is the
+*semantics*, not the machinery:
+
+* ranks are deterministic: sorted hostnames, master = rank 0
+  (reference distributed.py:155, :207),
+* before training, hosts exchange "do I have data?" and hosts without data
+  exit(0) while the rest re-form the cluster (the reference's double rabit
+  init, :78-109),
+* DNS wait with exponential backoff up to ~15 min before any distributed work
+  (:30-39).
+
+What's gone: the tree/ring allreduce topology and per-iteration model
+broadcast — gradient histograms are psum'd *inside* the jitted round step
+over the JAX mesh (ICI/DCN), which XLA schedules; there is nothing to
+hand-route. The TCP exchange here is a tiny metadata-only allgather used
+once at startup (the analog of RabitHelper.synchronize, :125-138), not a
+training-path collective. ``jax.distributed.initialize`` (coordinator =
+sorted-hosts[0]) brings up the multi-host XLA runtime itself.
+"""
+
+import json
+import logging
+import socket
+import struct
+import time
+
+from ..toolkit import exceptions as exc
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 9099
+
+
+def wait_hostname_resolution(sm_hosts, max_wait_seconds=900):
+    """Block until every host resolves in DNS (exponential backoff)."""
+    delay = 1.0
+    deadline = time.time() + max_wait_seconds
+    for host in sm_hosts:
+        while True:
+            try:
+                socket.gethostbyname(host)
+                break
+            except socket.gaierror:
+                if time.time() > deadline:
+                    raise exc.PlatformError(
+                        "Could not resolve hostname {} within {}s".format(
+                            host, max_wait_seconds
+                        )
+                    )
+                time.sleep(min(delay, 30.0))
+                delay *= 2
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, obj):
+    payload = json.dumps(obj).encode()
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return json.loads(_recv_exact(sock, length).decode())
+
+
+class Cluster:
+    """Deterministic-rank host group with a one-shot metadata allgather."""
+
+    def __init__(self, hosts, current_host, port=DEFAULT_PORT):
+        self.hosts = sorted(hosts)
+        self.current_host = current_host
+        self.port = port
+        self.rank = self.hosts.index(current_host)
+        self.master_host = self.hosts[0]
+
+    @property
+    def is_master(self):
+        return self.rank == 0
+
+    @property
+    def num_hosts(self):
+        return len(self.hosts)
+
+    def synchronize(self, payload, timeout=300):
+        """Allgather small JSON payloads across hosts -> list in rank order.
+
+        Master accepts one connection per worker, collects payloads, sends
+        the full rank-ordered list back (the reference's synchronize,
+        distributed.py:125-138). Single-host clusters short-circuit.
+        """
+        if self.num_hosts == 1:
+            return [payload]
+        if self.is_master:
+            results = {0: payload}
+            server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            server.bind(("0.0.0.0", self.port))
+            server.listen(self.num_hosts)
+            server.settimeout(timeout)
+            conns = []
+            try:
+                while len(results) < self.num_hosts:
+                    conn, _ = server.accept()
+                    msg = _recv_msg(conn)
+                    results[int(msg["rank"])] = msg["payload"]
+                    conns.append(conn)
+                ordered = [results[r] for r in range(self.num_hosts)]
+                for conn in conns:
+                    _send_msg(conn, ordered)
+                    conn.close()
+            finally:
+                server.close()
+            return ordered
+        # worker: connect with retry (master may be slow to bind)
+        deadline = time.time() + timeout
+        last_err = None
+        while time.time() < deadline:
+            try:
+                sock = socket.create_connection((self.master_host, self.port), timeout=10)
+                try:
+                    _send_msg(sock, {"rank": self.rank, "payload": payload})
+                    sock.settimeout(timeout)
+                    return _recv_msg(sock)
+                finally:
+                    sock.close()
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                time.sleep(1.0)
+        raise exc.PlatformError(
+            "Could not synchronize with master {}".format(self.master_host),
+            caused_by=last_err,
+        )
+
+
+def distributed_run(exec_fun, args, include_in_training, hosts, current_host, port=DEFAULT_PORT):
+    """Membership-aware distributed execution (the reference's rabit_run).
+
+    1. allgather {host, include_in_training};
+    2. hosts without data log and exit(0) — the cluster re-forms without them;
+    3. the rest run ``exec_fun(**args, is_master=...)`` where master is the
+       first participating host in sorted order.
+    """
+    cluster = Cluster(hosts, current_host, port=port)
+    membership = cluster.synchronize(
+        {"host": current_host, "include_in_training": bool(include_in_training)}
+    )
+    participating = sorted(
+        m["host"] for m in membership if m["include_in_training"]
+    )
+    if not participating:
+        raise exc.UserError(
+            "Not a single machine in the cluster has training data; "
+            "unable to train the model."
+        )
+    if not include_in_training:
+        logger.warning(
+            "Host %s does not have data, exiting from cluster.", current_host
+        )
+        return None
+    is_master = participating[0] == current_host
+    args = dict(args)
+    args["is_master"] = is_master
+    return exec_fun(**args)

@@ -1,0 +1,2 @@
+from .app import ScoringService, make_app  # noqa: F401
+from .server import serving_entrypoint  # noqa: F401

@@ -1,0 +1,256 @@
+"""The scoring WSGI application: /ping, /invocations, /execution-parameters.
+
+Route + status-code parity with the reference Flask app
+(algorithm_mode/serve.py:138-249): 204 on empty payload, 415 on undecodable
+content, 400 on predict failure, 406 on bad accept, 500 on model-load
+failure; accept negotiation falls back to SAGEMAKER_DEFAULT_INVOCATIONS_ACCEPT
+(default text/csv); MAX_CONTENT_LENGTH (6MB default) returns 413.
+
+Implemented as a dependency-free WSGI callable (no flask/gunicorn in this
+image) so it can run under any WSGI server — ours is the threaded server in
+``server.py``. One process owns the TPU; worker threads share the compiled
+forest kernel (predictions are pure jitted functions, safe across threads),
+replacing the reference's worker-per-copy + nthread=1 workaround
+(serve.py:92-107).
+"""
+
+import http.client
+import json
+import logging
+import multiprocessing
+import os
+
+from .. import constants
+from ..toolkit import exceptions as exc
+from . import serve_utils
+
+logger = logging.getLogger(__name__)
+
+SUPPORTED_ACCEPTS = [
+    "application/json",
+    "application/jsonlines",
+    "application/x-recordio-protobuf",
+    "text/csv",
+]
+
+PARSED_MAX_CONTENT_LENGTH = int(os.getenv("MAX_CONTENT_LENGTH", "6291456"))
+
+
+def number_of_workers():
+    return multiprocessing.cpu_count()
+
+
+class ScoringService:
+    """Lazy model holder for the single-model endpoint."""
+
+    def __init__(self, model_dir=None):
+        self.model_dir = model_dir or os.getenv(constants.SM_MODEL_DIR, "/opt/ml/model")
+        self.model = None
+        self.model_format = None
+
+    def load_model(self):
+        if self.model is None:
+            self.model, self.model_format = serve_utils.get_loaded_booster(
+                self.model_dir, serve_utils.is_ensemble_enabled()
+            )
+        return self.model_format
+
+    @property
+    def objective(self):
+        model = self.model[0] if isinstance(self.model, list) else self.model
+        return model.objective_name if model else None
+
+    @property
+    def num_class(self):
+        model = self.model[0] if isinstance(self.model, list) else self.model
+        return str(model.num_class or "") if model else ""
+
+    def predict(self, dtest, content_type):
+        return serve_utils.predict(
+            self.model, self.model_format, dtest, content_type, objective=self.objective
+        )
+
+
+def _response(start_response, status, body=b"", content_type="text/plain"):
+    if isinstance(body, str):
+        body = body.encode("utf-8")
+    start_response(
+        "{} {}".format(status, http.client.responses.get(status, "")),
+        [("Content-Type", content_type), ("Content-Length", str(len(body)))],
+    )
+    return [body]
+
+
+def parse_accept(environ):
+    accept = environ.get("HTTP_ACCEPT", "").split(";")[0].strip().lower()
+    if not accept or accept == "*/*":
+        return os.getenv(constants.SAGEMAKER_DEFAULT_INVOCATIONS_ACCEPT, "text/csv")
+    if accept not in SUPPORTED_ACCEPTS:
+        raise ValueError(
+            "Accept type {} is not supported. Please use supported accept types: {}.".format(
+                accept, SUPPORTED_ACCEPTS
+            )
+        )
+    return accept
+
+
+def _read_body(environ):
+    try:
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+    except ValueError:
+        length = 0
+    if length > PARSED_MAX_CONTENT_LENGTH:
+        raise exc.UserError("Payload too large")
+    return environ["wsgi.input"].read(length) if length else b""
+
+
+def make_app(scoring_service=None, hooks=None):
+    """Build the WSGI callable.
+
+    hooks: optional script-mode override dict with any of model_fn/input_fn/
+    predict_fn/output_fn/transform_fn (reference serving.py:63-134).
+    """
+    service = scoring_service or ScoringService()
+    hooks = hooks or {}
+
+    def handle_invocations(environ, start_response):
+        payload = _read_body(environ)
+        if len(payload) == 0:
+            return _response(start_response, http.client.NO_CONTENT)
+        content_type = environ.get("CONTENT_TYPE", "text/csv")
+
+        try:
+            accept = parse_accept(environ)
+        except ValueError as e:
+            return _response(start_response, http.client.NOT_ACCEPTABLE, str(e))
+
+        if "transform_fn" in hooks:
+            try:
+                model = _hooked_model(service, hooks)
+                result, out_type = hooks["transform_fn"](model, payload, content_type, accept)
+                return _response(start_response, http.client.OK, result, out_type)
+            except Exception as e:
+                logger.exception("transform_fn failed")
+                return _response(start_response, http.client.BAD_REQUEST, str(e))
+
+        try:
+            if "input_fn" in hooks:
+                dtest = hooks["input_fn"](payload, content_type)
+                parsed_type = content_type.split(";")[0]
+            else:
+                dtest, parsed_type = serve_utils.parse_content_data(payload, content_type)
+        except Exception as e:
+            logger.exception("decode failed")
+            return _response(start_response, http.client.UNSUPPORTED_MEDIA_TYPE, str(e))
+
+        try:
+            model = _hooked_model(service, hooks)
+        except Exception as e:
+            logger.exception("model load failed")
+            return _response(
+                start_response,
+                http.client.INTERNAL_SERVER_ERROR,
+                "Unable to load model: %s" % e,
+            )
+
+        try:
+            if "predict_fn" in hooks:
+                preds = hooks["predict_fn"](dtest, model)
+            else:
+                preds = service.predict(dtest, parsed_type)
+        except Exception as e:
+            logger.exception("predict failed")
+            return _response(
+                start_response,
+                http.client.BAD_REQUEST,
+                "Unable to evaluate payload provided: %s" % e,
+            )
+
+        if "output_fn" in hooks:
+            try:
+                body, out_type = hooks["output_fn"](preds, accept)
+                return _response(start_response, http.client.OK, body, out_type)
+            except Exception as e:
+                return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
+
+        if serve_utils.is_selectable_inference_output():
+            try:
+                keys = serve_utils.get_selected_output_keys()
+                selected = serve_utils.get_selected_predictions(
+                    preds, keys, service.objective, num_class=service.num_class
+                )
+                body = serve_utils.encode_selected_predictions(selected, keys, accept)
+                return _response(start_response, http.client.OK, body, accept)
+            except Exception as e:
+                logger.exception("selectable inference failed")
+                return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
+
+        import numpy as np
+
+        preds_list = np.asarray(preds).tolist()
+        if os.getenv(constants.SAGEMAKER_BATCH):
+            body = "\n".join(map(str, preds_list)) + "\n"
+        elif accept == "application/json":
+            body = serve_utils.encode_predictions_as_json(preds_list)
+        elif accept == "application/jsonlines":
+            body = serve_utils.encode_selected_predictions(
+                [{"score": p} for p in preds_list], ["score"], accept
+            )
+        elif accept == "application/x-recordio-protobuf":
+            from ..data.recordio import write_recordio_protobuf
+
+            body = write_recordio_protobuf(
+                np.asarray(preds_list, np.float32).reshape(len(preds_list), -1)
+            )
+        else:
+            body = "\n".join(
+                ",".join(map(str, p)) if isinstance(p, list) else str(p)
+                for p in preds_list
+            )
+        return _response(start_response, http.client.OK, body, accept)
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        try:
+            if path == "/ping" and method == "GET":
+                try:
+                    _hooked_model(service, hooks)
+                    return _response(start_response, http.client.OK)
+                except Exception as e:
+                    logger.exception("ping model load failed")
+                    return _response(
+                        start_response, http.client.INTERNAL_SERVER_ERROR, str(e)
+                    )
+            if path == "/execution-parameters" and method == "GET":
+                parameters = {
+                    "MaxConcurrentTransforms": number_of_workers(),
+                    "BatchStrategy": "MULTI_RECORD",
+                    "MaxPayloadInMB": int(PARSED_MAX_CONTENT_LENGTH / (1024**2)),
+                }
+                return _response(
+                    start_response,
+                    http.client.OK,
+                    json.dumps(parameters),
+                    "application/json",
+                )
+            if path == "/invocations" and method == "POST":
+                return handle_invocations(environ, start_response)
+            return _response(start_response, http.client.NOT_FOUND, "not found")
+        except exc.UserError as e:
+            return _response(start_response, http.client.REQUEST_ENTITY_TOO_LARGE, str(e))
+        except Exception as e:  # last-resort 500
+            logger.exception("unhandled serving error")
+            return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
+
+    return app
+
+
+def _hooked_model(service, hooks):
+    if "model_fn" in hooks:
+        if service.model is None:
+            service.model = hooks["model_fn"](service.model_dir)
+            service.model_format = "user"
+        return service.model
+    service.load_model()
+    return service.model

@@ -1,0 +1,102 @@
+"""Inference payload decoding: request bytes -> DataMatrix.
+
+Parity with reference encoder.py:35-142 (csv delimiter sniffing with alnum
+fallback, blank-cell -> NaN, libsvm 1-based index shift at serve time, recordio
+passthrough) and the jsonlines conversion helper. Decoders return label-free
+DataMatrix objects for the predict path.
+"""
+
+import csv as csv_module
+import io
+import json
+
+import numpy as np
+import scipy.sparse as sp
+
+from .. import constants
+from ..data.matrix import DataMatrix
+from ..data.recordio import read_recordio_protobuf
+from ..toolkit import exceptions as exc
+
+
+def _clean_csv_cells(line, delimiter):
+    return ["nan" if cell == "" else cell for cell in line.split(delimiter)]
+
+
+def csv_to_matrix(input_data, dtype=np.float32):
+    """CSV request body (no label column) -> DataMatrix."""
+    text = input_data.decode() if isinstance(input_data, (bytes, bytearray)) else input_data
+    first_line = text.split("\n")[0][:512]
+    try:
+        sniffed = csv_module.Sniffer().sniff(first_line).delimiter
+    except Exception:
+        sniffed = ","
+    delimiter = "," if sniffed.isalnum() else sniffed
+    rows = [_clean_csv_cells(line, delimiter) for line in text.split("\n") if line != ""]
+    data = np.asarray(rows).astype(dtype)
+    return DataMatrix(data)
+
+
+def libsvm_to_matrix(string_like):
+    """LIBSVM request body (no labels) -> DataMatrix.
+
+    Serve-time payloads conventionally use standard 1-based libsvm indices;
+    when every index is >= 1 they are shifted down by one (reference
+    encoder.py:78-81 / serve_utils.py:110-113).
+    """
+    if isinstance(string_like, (bytes, bytearray)):
+        string_like = string_like.decode("utf-8")
+    row_ids, col_ids, values = [], [], []
+    n_rows = 0
+    for line in string_like.strip().split("\n"):
+        tokens = line.strip().split()
+        for token in tokens:
+            if ":" in token:
+                idx, _, val = token.partition(":")
+                row_ids.append(n_rows)
+                col_ids.append(int(idx))
+                values.append(float(val))
+        n_rows += 1
+    if not values:
+        return DataMatrix(np.full((max(n_rows, 0), 0), np.nan, np.float32))
+    col_ids = np.asarray(col_ids, np.int64)
+    if col_ids.min() >= 1:
+        col_ids = col_ids - 1
+    csr = sp.csr_matrix(
+        (np.asarray(values, np.float32), (np.asarray(row_ids), col_ids)),
+        shape=(n_rows, int(col_ids.max()) + 1),
+    )
+    return DataMatrix(csr)
+
+
+def recordio_protobuf_to_matrix(string_like):
+    features, _labels = read_recordio_protobuf(bytes(string_like))
+    return DataMatrix(features)
+
+
+_decoders = {
+    constants.CSV: csv_to_matrix,
+    constants.LIBSVM: libsvm_to_matrix,
+    constants.X_LIBSVM: libsvm_to_matrix,
+    constants.X_RECORDIO_PROTOBUF: recordio_protobuf_to_matrix,
+}
+
+
+def json_to_jsonlines(json_data):
+    """``{"predictions": [...]}`` -> one JSON document per line (bytes)."""
+    resp = json_data if isinstance(json_data, dict) else json.loads(json_data)
+    if len(resp.keys()) != 1:
+        raise ValueError("JSON response is not compatible for conversion to jsonlines.")
+    bio = io.BytesIO()
+    for value in resp.values():
+        for entry in value:
+            bio.write(bytes(json.dumps(entry) + "\n", "UTF-8"))
+    return bio.getvalue()
+
+
+def decode(obj, content_type):
+    media_type = str(content_type).split(";")[0].strip()
+    decoder = _decoders.get(media_type)
+    if decoder is None:
+        raise exc.UserError("Content type {} is not supported".format(media_type))
+    return decoder(obj)

@@ -1,0 +1,201 @@
+"""Multi-model endpoint: the MMS (Java frontend) replacement.
+
+The reference runs multi-model endpoints through the multi-model-server Java
+process + per-model Python workers (serving_mms.py / mms_patch). On TPU one
+process owns the chip, so the frontend collapses into a pure-Python model
+manager exposing MMS's REST surface (exercised by the reference's
+test/integration/local/test_multiple_model_endpoint.py:32-101):
+
+* ``POST   /models``                 {"model_name": n, "url": dir}  -> load
+* ``GET    /models``                 -> list
+* ``GET    /models/<name>``          -> describe
+* ``DELETE /models/<name>``          -> unload
+* ``POST   /models/<name>/invoke``   -> predict
+
+Loaded models hold compiled predict kernels; an LRU cap (env
+``SAGEMAKER_MAX_MODELS``, default unlimited) evicts the coldest model.
+"""
+
+import collections
+import http.client
+import json
+import logging
+import os
+import threading
+
+from .. import constants
+from . import serve_utils
+from .app import PARSED_MAX_CONTENT_LENGTH, _read_body, _response, parse_accept
+
+logger = logging.getLogger(__name__)
+
+
+class ModelManager:
+    def __init__(self, max_models=None):
+        self._models = collections.OrderedDict()  # name -> (model, format, dir)
+        self._lock = threading.Lock()
+        self.max_models = max_models or int(os.getenv("SAGEMAKER_MAX_MODELS", "0")) or None
+
+    def load(self, name, url):
+        model_dir = url
+        if not os.path.isdir(model_dir):
+            raise FileNotFoundError("model url {} is not a directory".format(url))
+        model, fmt = serve_utils.get_loaded_booster(
+            model_dir, serve_utils.is_ensemble_enabled()
+        )
+        with self._lock:
+            if name in self._models:
+                raise KeyError("model {} is already loaded".format(name))
+            self._models[name] = (model, fmt, model_dir)
+            if self.max_models and len(self._models) > self.max_models:
+                evicted, _ = self._models.popitem(last=False)
+                logger.info("Evicted model %s (LRU cap %d)", evicted, self.max_models)
+
+    def unload(self, name):
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(name)
+            del self._models[name]
+
+    def get(self, name):
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(name)
+            self._models.move_to_end(name)
+            return self._models[name]
+
+    def list(self):
+        with self._lock:
+            return [
+                {"modelName": name, "modelUrl": entry[2]}
+                for name, entry in self._models.items()
+            ]
+
+
+def make_mme_app(manager=None):
+    manager = manager or ModelManager()
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/").rstrip("/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        try:
+            if path == "/ping" and method == "GET":
+                return _response(start_response, http.client.OK, json.dumps({"status": "Healthy"}), "application/json")
+
+            if path == "/models" and method == "GET":
+                body = json.dumps({"models": manager.list()})
+                return _response(start_response, http.client.OK, body, "application/json")
+
+            if path == "/models" and method == "POST":
+                params = _query_params(environ)
+                if environ.get("CONTENT_TYPE", "").startswith("application/json"):
+                    payload = json.loads(_read_body(environ) or b"{}")
+                else:
+                    payload = {}
+                name = payload.get("model_name") or params.get("model_name")
+                url = payload.get("url") or params.get("url")
+                if not name or not url:
+                    return _response(
+                        start_response, http.client.BAD_REQUEST, "model_name and url required"
+                    )
+                try:
+                    manager.load(name, url)
+                except KeyError as e:
+                    return _response(start_response, http.client.CONFLICT, str(e))
+                except FileNotFoundError as e:
+                    return _response(start_response, http.client.NOT_FOUND, str(e))
+                except Exception as e:
+                    logger.exception("model load failed")
+                    return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
+                return _response(
+                    start_response,
+                    http.client.OK,
+                    json.dumps({"status": "Workers scaled for model: " + name}),
+                    "application/json",
+                )
+
+            if path.startswith("/models/"):
+                remainder = path[len("/models/"):]
+                if remainder.endswith("/invoke"):
+                    name = remainder[: -len("/invoke")]
+                    if method != "POST":
+                        return _response(start_response, http.client.METHOD_NOT_ALLOWED)
+                    return _invoke(manager, name, environ, start_response)
+                name = remainder
+                if method == "GET":
+                    try:
+                        _model, fmt, model_dir = manager.get(name)
+                    except KeyError:
+                        return _response(start_response, http.client.NOT_FOUND, "model not found")
+                    body = json.dumps([{"modelName": name, "modelUrl": model_dir, "format": fmt}])
+                    return _response(start_response, http.client.OK, body, "application/json")
+                if method == "DELETE":
+                    try:
+                        manager.unload(name)
+                    except KeyError:
+                        return _response(start_response, http.client.NOT_FOUND, "model not found")
+                    return _response(
+                        start_response,
+                        http.client.OK,
+                        json.dumps({"status": "Model \"{}\" unregistered".format(name)}),
+                        "application/json",
+                    )
+            # single-model invocations path also works when exactly one model loaded
+            if path == "/invocations" and method == "POST":
+                models = manager.list()
+                if len(models) != 1:
+                    return _response(
+                        start_response, http.client.BAD_REQUEST,
+                        "multi-model endpoint: use /models/<name>/invoke",
+                    )
+                return _invoke(manager, models[0]["modelName"], environ, start_response)
+            return _response(start_response, http.client.NOT_FOUND, "not found")
+        except Exception as e:
+            logger.exception("unhandled MME error")
+            return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
+
+    return app
+
+
+def _query_params(environ):
+    from urllib.parse import parse_qs
+
+    qs = parse_qs(environ.get("QUERY_STRING", ""))
+    return {k: v[0] for k, v in qs.items()}
+
+
+def _invoke(manager, name, environ, start_response):
+    try:
+        model, fmt, _dir = manager.get(name)
+    except KeyError:
+        return _response(start_response, http.client.NOT_FOUND, "model not found")
+    payload = _read_body(environ)
+    if not payload:
+        return _response(start_response, http.client.NO_CONTENT)
+    content_type = environ.get("CONTENT_TYPE", "text/csv")
+    try:
+        dtest, parsed_type = serve_utils.parse_content_data(payload, content_type)
+    except Exception as e:
+        return _response(start_response, http.client.UNSUPPORTED_MEDIA_TYPE, str(e))
+    try:
+        accept = parse_accept(environ)
+    except ValueError as e:
+        return _response(start_response, http.client.NOT_ACCEPTABLE, str(e))
+    try:
+        first = model[0] if isinstance(model, list) else model
+        preds = serve_utils.predict(
+            model, fmt, dtest, parsed_type, objective=first.objective_name
+        )
+    except Exception as e:
+        logger.exception("invoke predict failed")
+        return _response(start_response, http.client.BAD_REQUEST, str(e))
+    import numpy as np
+
+    preds_list = np.asarray(preds).tolist()
+    if accept == "application/json":
+        body = serve_utils.encode_predictions_as_json(preds_list)
+    else:
+        body = "\n".join(
+            ",".join(map(str, p)) if isinstance(p, list) else str(p) for p in preds_list
+        )
+    return _response(start_response, http.client.OK, body, accept)

@@ -1,0 +1,110 @@
+"""The ``serve`` entrypoint: threaded WSGI server + mode dispatch.
+
+Reference: serving.py:140-169 (gunicorn+Flask or MMS). Here a single process
+owns the TPU and a thread pool handles HTTP (no gunicorn/gevent in the
+image; prediction is a compiled XLA kernel, so the GIL is released during
+compute and worker-per-copy is unnecessary). Dispatch:
+
+* SAGEMAKER_MULTI_MODEL=true  -> multi-model manager app (mme.py),
+* user inference module found -> its model_fn/input_fn/predict_fn/output_fn/
+  transform_fn override the algorithm handlers (serving.py:63-134),
+* otherwise                    -> algorithm-mode scoring app.
+
+``OMP_NUM_THREADS`` defaults to 1 as in the reference (serving.py:46-60) so
+host-side numpy work doesn't oversubscribe the VM.
+"""
+
+import importlib.util
+import logging
+import os
+import signal
+import sys
+from socketserver import ThreadingMixIn
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+
+from .. import constants
+from .app import ScoringService, make_app
+from .mme import make_mme_app
+
+logger = logging.getLogger(__name__)
+
+HOOK_NAMES = ("model_fn", "input_fn", "predict_fn", "output_fn", "transform_fn")
+
+
+class _ThreadedWSGIServer(ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, format, *args):  # route access logs through logging
+        logger.debug("%s - %s", self.address_string(), format % args)
+
+
+def set_default_serving_env_if_unspecified():
+    os.environ.setdefault("OMP_NUM_THREADS", constants.ONE_THREAD_PER_PROCESS)
+
+
+def is_multi_model():
+    return os.environ.get("SAGEMAKER_MULTI_MODEL", "").lower() == "true"
+
+
+def _load_user_hooks(model_dir):
+    """Import the customer's inference script if present; return hook dict."""
+    program = os.environ.get("SAGEMAKER_PROGRAM")
+    candidates = []
+    if program:
+        for base in (
+            os.environ.get("SAGEMAKER_SUBMIT_DIRECTORY", ""),
+            os.path.join(model_dir, "code"),
+            model_dir,
+        ):
+            if base:
+                candidates.append(os.path.join(base, program))
+    script = next((c for c in candidates if os.path.isfile(c)), None)
+    if script is None:
+        return {}
+    spec = importlib.util.spec_from_file_location("user_inference_module", script)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, os.path.dirname(script))
+    spec.loader.exec_module(module)
+    hooks = {name: getattr(module, name) for name in HOOK_NAMES if hasattr(module, name)}
+    logger.info("Loaded user serving hooks from %s: %s", script, sorted(hooks))
+    return hooks
+
+
+def build_app():
+    if is_multi_model():
+        logger.info("Starting multi-model endpoint manager")
+        return make_mme_app()
+    model_dir = os.getenv(constants.SM_MODEL_DIR, "/opt/ml/model")
+    hooks = _load_user_hooks(model_dir)
+    return make_app(ScoringService(model_dir), hooks=hooks)
+
+
+def serving_entrypoint(port=None, block=True):
+    set_default_serving_env_if_unspecified()
+    logging.basicConfig(level=logging.INFO)
+    port = int(port or os.getenv("SAGEMAKER_BIND_TO_PORT", 8080))
+    app = build_app()
+    httpd = make_server(
+        "0.0.0.0", port, app, server_class=_ThreadedWSGIServer, handler_class=_QuietHandler
+    )
+
+    def _shutdown(signo, frame):
+        logger.info("Received signal %s, shutting down", signo)
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    logger.info("Serving on port %d", port)
+    if block:
+        httpd.serve_forever()
+    return httpd
+
+
+def main():
+    serving_entrypoint()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .algorithm_train import sagemaker_train, train_job  # noqa: F401

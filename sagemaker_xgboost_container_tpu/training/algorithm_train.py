@@ -1,0 +1,339 @@
+"""Algorithm-mode training orchestration: ``sagemaker_train`` + ``train_job``.
+
+The control flow mirrors the reference (algorithm_mode/train.py:116-500):
+validate HPs and channels, load + validate data matrices, pick single-host vs
+multi-host, run boosting with the callback stack, optionally repeated k-fold
+CV with out-of-fold prediction recording, and save model(s) master-only under
+the exact ``xgboost-model[-fold]`` names. Errors matching the known customer
+substrings re-raise as UserError (reference :461-467).
+
+The compute underneath is the XLA booster (models/booster.py); "use_dask_gpu_
+training" is rejected up-front — the data-parallel TPU mesh subsumes that
+path.
+"""
+
+import logging
+import os
+
+import numpy as np
+from sklearn.model_selection import RepeatedKFold, RepeatedStratifiedKFold
+
+from ..algorithm import channels as cv
+from ..algorithm import hyperparameters as hpv
+from ..algorithm import metrics as metrics_mod
+from ..constants import CUSTOMER_ERRORS, MODEL_NAME
+from ..data.content_types import get_content_type
+from ..data.readers import (
+    check_data_redundancy,
+    get_data_matrix,
+    get_size,
+    validate_data_file_path,
+)
+from ..parallel import distributed
+from ..toolkit import exceptions as exc
+from ..toolkit.channels import PIPE_MODE
+from ..models import booster
+from . import train_utils
+from .callbacks import get_callbacks
+from .prediction_utils import ValidationPredictionRecorder
+
+logger = logging.getLogger(__name__)
+
+SM_OUTPUT_DATA_DIR = "SM_OUTPUT_DATA_DIR"
+
+
+def get_validated_data_matrices(
+    train_path, validate_path, content_type, csv_weights=0, is_pipe=False, combine_train_val=False
+):
+    """Size/format-check both channels and parse them into DataMatrix objects."""
+    train_size = get_size(train_path, is_pipe) if train_path else 0
+    val_size = get_size(validate_path, is_pipe) if validate_path else 0
+
+    if not is_pipe:
+        if train_size > 0:
+            validate_data_file_path(train_path, content_type)
+        if val_size > 0:
+            validate_data_file_path(validate_path, content_type)
+
+    train_dmatrix = (
+        get_data_matrix(train_path, content_type, csv_weights=csv_weights, is_pipe=is_pipe)
+        if train_size > 0
+        else None
+    )
+    val_dmatrix = (
+        get_data_matrix(validate_path, content_type, csv_weights=csv_weights, is_pipe=is_pipe)
+        if val_size > 0
+        else None
+    )
+
+    train_val_dmatrix = train_dmatrix
+    if combine_train_val and train_dmatrix is not None and val_dmatrix is not None:
+        logger.info("Read both train and validation data into one DataMatrix")
+        train_val_dmatrix = train_dmatrix.concat(val_dmatrix)
+    return train_dmatrix, val_dmatrix, train_val_dmatrix
+
+
+def sagemaker_train(
+    train_config,
+    data_config,
+    train_path,
+    val_path,
+    model_dir,
+    sm_hosts,
+    sm_current_host,
+    checkpoint_config,
+):
+    """Validate config, load data, select execution mode, run train_job."""
+    metrics = metrics_mod.initialize()
+    hyperparameters = hpv.initialize(metrics)
+    validated_train_config = hyperparameters.validate(train_config)
+    if validated_train_config.get("updater"):
+        validated_train_config["updater"] = ",".join(validated_train_config["updater"])
+
+    channels = cv.initialize()
+    validated_data_config = channels.validate(data_config)
+
+    file_type = get_content_type(validated_data_config["train"].get("ContentType"))
+    input_mode = validated_data_config["train"].get("TrainingInputMode")
+    csv_weights = validated_train_config.get("csv_weights", 0)
+    is_pipe = input_mode == PIPE_MODE
+
+    validation_channel = validated_data_config.get("validation", None)
+    combine_train_val = "_kfold" in validated_train_config
+    if val_path is not None:
+        if train_path == val_path or os.path.basename(train_path) == os.path.basename(val_path):
+            logger.warning(
+                "Found same path for training and validation. This is not recommended "
+                "and results may not be correct."
+            )
+        elif not is_pipe:
+            check_data_redundancy(train_path, val_path)
+
+    num_hosts = len(sm_hosts)
+    checkpoint_dir = checkpoint_config.get("LocalPath", None)
+
+    if validated_train_config.pop("use_dask_gpu_training", "false") == "true":
+        raise exc.UserError(
+            "use_dask_gpu_training is not available in the TPU container: there are no "
+            "CUDA devices. Distributed training runs data-parallel over the TPU mesh "
+            "automatically — remove this hyperparameter."
+        )
+
+    train_dmatrix, val_dmatrix, train_val_dmatrix = get_validated_data_matrices(
+        train_path, val_path, file_type, csv_weights, is_pipe, combine_train_val
+    )
+    missing_validation_data = validation_channel and not val_dmatrix
+
+    train_args = dict(
+        train_cfg=validated_train_config,
+        train_dmatrix=train_dmatrix,
+        val_dmatrix=val_dmatrix,
+        train_val_dmatrix=train_val_dmatrix,
+        model_dir=model_dir,
+        checkpoint_dir=checkpoint_dir,
+    )
+
+    if num_hosts > 1:
+        logger.info("Distributed node training with %d hosts: %s", num_hosts, sm_hosts)
+        distributed.wait_hostname_resolution(sm_hosts)
+        include_in_training = True
+        if not train_dmatrix:
+            logger.warning(
+                "Host %s does not have training data and will not be used in "
+                "distributed training. Please divide the training data across "
+                "instances properly.",
+                sm_current_host,
+            )
+            include_in_training = False
+        if missing_validation_data:
+            logger.warning(
+                "Host %s does not have validation data in the validation channel and "
+                "will not be used in distributed training.",
+                sm_current_host,
+            )
+            include_in_training = False
+        distributed.distributed_run(
+            exec_fun=train_job,
+            args=train_args,
+            include_in_training=include_in_training,
+            hosts=sm_hosts,
+            current_host=sm_current_host,
+        )
+    elif num_hosts == 1:
+        if train_dmatrix:
+            if missing_validation_data:
+                raise exc.UserError("No data in validation channel path {}".format(val_path))
+            logger.info("Single node training.")
+            train_args.update({"is_master": True})
+            train_job(**train_args)
+        else:
+            raise exc.UserError("No data in training channel path {}".format(train_path))
+    else:
+        raise exc.PlatformError("Number of hosts should be an int greater than or equal to 1")
+
+
+def train_job(
+    train_cfg, train_dmatrix, val_dmatrix, train_val_dmatrix, model_dir, checkpoint_dir, is_master
+):
+    """Run boosting (or repeated k-fold CV) on this node; save master-only."""
+    train_cfg = dict(train_cfg)
+    num_round = train_cfg.pop("num_round")
+    save_model_on_termination = train_cfg.pop("save_model_on_termination", "false")
+
+    tuning_objective_metric_param = train_cfg.pop("_tuning_objective_metric", None)
+    eval_metric = train_cfg.get("eval_metric")
+    cleaned_eval_metric, configured_feval, tuning_objective_metric = (
+        train_utils.get_eval_metrics_and_feval(tuning_objective_metric_param, eval_metric)
+    )
+    if cleaned_eval_metric:
+        train_cfg["eval_metric"] = cleaned_eval_metric
+    else:
+        train_cfg.pop("eval_metric", None)
+
+    early_stopping_rounds = train_cfg.pop("early_stopping_rounds", None)
+    early_stopping_data_name = "validation" if val_dmatrix else None
+    early_stopping_metric = None
+    if early_stopping_rounds:
+        if tuning_objective_metric:
+            early_stopping_metric = tuning_objective_metric[-1]
+        elif eval_metric:
+            early_stopping_metric = eval_metric[-1]
+
+    logger.info(
+        "Train matrix has %d rows and %d columns",
+        train_dmatrix.num_row,
+        train_dmatrix.num_col,
+    )
+    if val_dmatrix:
+        logger.info("Validation matrix has %d rows", val_dmatrix.num_row)
+
+    try:
+        kfold = train_cfg.pop("_kfold", None)
+        watchlist = [(train_dmatrix, "train")]
+        if val_dmatrix is not None:
+            watchlist.append((val_dmatrix, "validation"))
+
+        if kfold is None:
+            xgb_model, iteration, callbacks = get_callbacks(
+                model_dir=model_dir,
+                checkpoint_dir=checkpoint_dir,
+                early_stopping_data_name=early_stopping_data_name,
+                early_stopping_metric=early_stopping_metric,
+                early_stopping_rounds=early_stopping_rounds,
+                save_model_on_termination=save_model_on_termination,
+                is_master=is_master,
+                num_round=num_round,
+            )
+            bst = booster.train(
+                train_cfg,
+                train_dmatrix,
+                num_boost_round=num_round - iteration,
+                evals=watchlist,
+                feval=configured_feval,
+                callbacks=callbacks,
+                xgb_model=xgb_model,
+            )
+        else:
+            num_cv_round = train_cfg.pop("_num_cv_round", 1)
+            logger.info(
+                "Run %s-round of %s-fold cross validation with %s rows",
+                num_cv_round,
+                kfold,
+                train_val_dmatrix.num_row,
+            )
+            bst = []
+            evals_results = []
+            num_class = train_cfg.get("num_class", None)
+            objective = train_cfg.get("objective") or ""
+            classification_problem = bool(num_class) or objective.startswith("binary:")
+            y = train_val_dmatrix.get_label() if classification_problem else None
+            rkf = (
+                RepeatedStratifiedKFold(n_splits=kfold, n_repeats=num_cv_round)
+                if y is not None
+                else RepeatedKFold(n_splits=kfold, n_repeats=num_cv_round)
+            )
+            val_pred = ValidationPredictionRecorder(
+                y_true=train_val_dmatrix.get_label(),
+                num_cv_round=num_cv_round,
+                classification=classification_problem,
+                output_data_dir=os.environ[SM_OUTPUT_DATA_DIR],
+            )
+            for train_idx, val_idx in rkf.split(X=range(train_val_dmatrix.num_row), y=y):
+                cv_train = train_val_dmatrix.slice(train_idx)
+                cv_val = train_val_dmatrix.slice(val_idx)
+                xgb_model, iteration, callbacks = get_callbacks(
+                    model_dir=model_dir,
+                    checkpoint_dir=checkpoint_dir,
+                    early_stopping_data_name=early_stopping_data_name,
+                    early_stopping_metric=early_stopping_metric,
+                    early_stopping_rounds=early_stopping_rounds,
+                    save_model_on_termination=save_model_on_termination,
+                    is_master=is_master,
+                    fold=len(bst),
+                    num_round=num_round,
+                )
+
+                class _EvalsRecorder:
+                    def __init__(self):
+                        self.log = {}
+
+                    def after_iteration(self, model, epoch, evals_log):
+                        self.log = {k: dict(v) for k, v in evals_log.items()}
+                        return False
+
+                recorder = _EvalsRecorder()
+                logger.info("Train cross validation fold %d", (len(bst) % kfold) + 1)
+                fold_booster = booster.train(
+                    train_cfg,
+                    cv_train,
+                    num_boost_round=num_round - iteration,
+                    evals=[(cv_train, "train"), (cv_val, "validation")],
+                    feval=configured_feval,
+                    callbacks=callbacks + [recorder],
+                    xgb_model=xgb_model,
+                )
+                bst.append(fold_booster)
+                evals_results.append(recorder.log)
+                val_pred.record(val_idx, fold_booster.predict(cv_val.features))
+                if len(bst) % kfold == 0:
+                    logger.info(
+                        "The metrics of round %d cross validation", len(bst) // kfold
+                    )
+                    print_cv_metric(num_round, evals_results[-kfold:])
+            val_pred.save()
+            if num_cv_round > 1:
+                logger.info(
+                    "The overall metrics of %s-round cross validation", num_cv_round
+                )
+                print_cv_metric(num_round, evals_results)
+    except Exception as e:
+        for customer_error_message in CUSTOMER_ERRORS:
+            if customer_error_message in str(e):
+                raise exc.UserError(str(e))
+        if isinstance(e, (exc.UserError, exc.PlatformError)):
+            raise
+        raise exc.AlgorithmError("XGB train call failed with exception:\n {}".format(e))
+
+    os.makedirs(model_dir, exist_ok=True)
+    if is_master:
+        if not isinstance(bst, list):
+            model_location = os.path.join(model_dir, MODEL_NAME)
+            bst.save_model(model_location)
+            logger.debug("Stored trained model at %s", model_location)
+        else:
+            for fold, fold_booster in enumerate(bst):
+                model_location = os.path.join(model_dir, "{}-{}".format(MODEL_NAME, fold))
+                fold_booster.save_model(model_location)
+                logger.debug("Stored trained model %d at %s", fold, model_location)
+
+
+def print_cv_metric(num_round, evals_results):
+    """One stdout line with per-metric CV means (reference train.py:489-500)."""
+    report = "[{}]".format(num_round)
+    data_names = evals_results[0].keys()
+    metric_names = evals_results[0]["train"].keys()
+    for metric_name in metric_names:
+        for data_name in data_names:
+            values = [r[data_name][metric_name][-1] for r in evals_results]
+            report += "\t{}-{}:{:.5f}".format(data_name, metric_name, float(np.mean(values)))
+    print(report, flush=True)

@@ -1,0 +1,147 @@
+"""Spot-safe checkpointing: save/resume + retention with SageMaker markers.
+
+Contract parity with the reference (checkpointing.py:139-453):
+
+* checkpoints are full serialized models named ``xgboost-checkpoint.<iter>``
+  in the checkpoint dir; resume picks the highest iteration and training
+  continues with ``num_round - iteration`` remaining rounds,
+* writes are atomic (tempfile + rename),
+* a daemon thread deletes all but the ``max_to_keep`` newest, deferring any
+  file SageMaker is mid-upload (``.sagemaker-uploading`` marker present and
+  ``.sagemaker-uploaded`` absent),
+* ``SaveIntermediateModel`` overwrites ``<model_dir>/<model_name>`` every
+  round so SIGTERM (spot interruption / HPO early stop) always leaves a
+  fresh model behind.
+"""
+
+import logging
+import os
+import queue
+import re
+import tempfile
+import threading
+
+TEMP_FILE_SUFFIX = ".sagemaker-ignore"
+FILE_LOCK_SUFFIX = ".sagemaker-uploading"
+FILE_SAFE_SUFFIX = ".sagemaker-uploaded"
+
+CHECKPOINT_FILENAME = "xgboost-checkpoint"
+
+logger = logging.getLogger(__name__)
+
+
+def load_checkpoint(checkpoint_dir):
+    """-> (model path or None, next iteration number)."""
+    if not checkpoint_dir or not os.path.exists(checkpoint_dir):
+        return None, 0
+    pattern = re.compile(r"^{}\.([0-9]+)$".format(re.escape(CHECKPOINT_FILENAME)))
+    found = []
+    for name in os.listdir(checkpoint_dir):
+        m = pattern.match(name)
+        if m:
+            found.append((int(m.group(1)), name))
+    if not found:
+        return None, 0
+    iteration, name = max(found)
+    return os.path.join(checkpoint_dir, name), iteration + 1
+
+
+def _atomic_save(model, directory, final_name):
+    with tempfile.NamedTemporaryFile(
+        dir=directory, suffix=TEMP_FILE_SUFFIX, delete=False, mode="w"
+    ) as tf:
+        tmp = tf.name
+    model.save_model(tmp)
+    os.rename(tmp, os.path.join(directory, final_name))
+
+
+class SaveCheckpointCallBack:
+    """Save a checkpoint each round; background-delete stale ones."""
+
+    SENTINEL = None
+
+    def __init__(self, checkpoint_dir, start_iteration=0, max_to_keep=5, num_round=None):
+        self.checkpoint_dir = checkpoint_dir
+        self.max_to_keep = max_to_keep
+        self.start_iteration = start_iteration
+        self.num_round = num_round
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.previous_checkpoints = {
+            os.path.join(checkpoint_dir, f) for f in os.listdir(checkpoint_dir)
+        }
+        self.delete_queue = queue.Queue()
+        self._start_deleter()
+
+    def format_path(self, iteration):
+        return os.path.join(
+            self.checkpoint_dir, "{}.{}".format(CHECKPOINT_FILENAME, iteration)
+        )
+
+    def after_iteration(self, model, epoch, evals_log):
+        _atomic_save(
+            model, self.checkpoint_dir, "{}.{}".format(CHECKPOINT_FILENAME, epoch)
+        )
+        self.delete_queue.put(epoch - self.max_to_keep)
+        if self.num_round is not None and epoch + 1 >= self.num_round:
+            self.stop()
+        return False
+
+    def after_training(self, model):
+        self.stop()
+        return model
+
+    # ------------------------------------------------------------- deleter
+    def _start_deleter(self):
+        def _is_uploading(path):
+            return os.path.isfile(path + FILE_LOCK_SUFFIX) and not os.path.isfile(
+                path + FILE_SAFE_SUFFIX
+            )
+
+        def _remove(path):
+            try:
+                os.remove(path)
+            except OSError:
+                logger.debug("Failed to delete %s", path)
+            finally:
+                self.delete_queue.task_done()
+
+        def _drain():
+            for iteration in iter(self.delete_queue.get, self.SENTINEL):
+                path = self.format_path(iteration)
+                if not os.path.isfile(path) or path in self.previous_checkpoints:
+                    self.delete_queue.task_done()
+                    continue
+                if _is_uploading(path):
+                    # SageMaker still uploading: requeue and revisit later
+                    self.delete_queue.put(iteration)
+                    continue
+                _remove(path)
+            self.delete_queue.task_done()
+            # training over: second pass removes stragglers regardless of locks
+            self.delete_queue.put(self.SENTINEL)
+            for iteration in iter(self.delete_queue.get, self.SENTINEL):
+                _remove(self.format_path(iteration))
+            self.delete_queue.task_done()
+
+        self.thread = threading.Thread(target=_drain, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        if self.thread.is_alive():
+            self.delete_queue.put(self.SENTINEL)
+            self.thread.join()
+
+
+class SaveIntermediateModelCallBack:
+    """Overwrite ``model_dir/model_name`` after every round (master only)."""
+
+    def __init__(self, intermediate_model_dir, model_name, is_master):
+        self.intermediate_model_dir = intermediate_model_dir
+        self.model_name = model_name
+        self.is_master = is_master
+        os.makedirs(intermediate_model_dir, exist_ok=True)
+
+    def after_iteration(self, model, epoch, evals_log):
+        if self.is_master:
+            _atomic_save(model, self.intermediate_model_dir, self.model_name)
+        return False

@@ -1,0 +1,139 @@
+"""The ``train`` entrypoint: algorithm mode vs user-script mode dispatch.
+
+Reference: training.py:29-103. Algorithm mode reads the SageMaker filesystem
+contract (SM_* env vars pointing at JSON config files + channel dirs) and
+calls ``sagemaker_train``. Script mode executes the customer's entry point
+(from the ``sagemaker_submit_directory``/code channel) as a subprocess with
+the full SM environment, like the sagemaker-containers runner did.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import tarfile
+
+from .. import constants
+from ..toolkit import exceptions as exc
+from .algorithm_train import sagemaker_train
+
+logger = logging.getLogger(__name__)
+
+FAILURE_FILE = "/opt/ml/output/failure"
+
+
+def _read_json(path, default=None):
+    if path and os.path.exists(path):
+        with open(path, "r") as f:
+            return json.load(f)
+    return default if default is not None else {}
+
+
+def run_algorithm_mode():
+    """Parse the SM env contract and run algorithm-mode training."""
+    train_config = _read_json(os.getenv(constants.SM_INPUT_TRAINING_CONFIG_FILE))
+    data_config = _read_json(os.getenv(constants.SM_INPUT_DATA_CONFIG_FILE))
+    checkpoint_config = _read_json(os.getenv(constants.SM_CHECKPOINT_CONFIG_FILE))
+
+    train_path = os.environ[constants.SM_CHANNEL_TRAIN]
+    val_path = os.environ.get(constants.SM_CHANNEL_VALIDATION)
+    sm_hosts = json.loads(os.environ[constants.SM_HOSTS])
+    sm_current_host = os.environ[constants.SM_CURRENT_HOST]
+    model_dir = os.getenv(constants.SM_MODEL_DIR)
+
+    sagemaker_train(
+        train_config=train_config,
+        data_config=data_config,
+        train_path=train_path,
+        val_path=val_path,
+        model_dir=model_dir,
+        sm_hosts=sm_hosts,
+        sm_current_host=sm_current_host,
+        checkpoint_config=checkpoint_config,
+    )
+
+
+def _stage_user_module(hyperparameters, code_dir="/opt/ml/code"):
+    """Unpack sagemaker_submit_directory (tar.gz or dir) into code_dir."""
+    submit_dir = hyperparameters.get("sagemaker_submit_directory")
+    if not submit_dir:
+        return None
+    os.makedirs(code_dir, exist_ok=True)
+    if os.path.isdir(submit_dir):
+        return submit_dir
+    if submit_dir.endswith(".tar.gz") and os.path.exists(submit_dir):
+        with tarfile.open(submit_dir) as tar:
+            tar.extractall(code_dir)
+        return code_dir
+    raise exc.UserError(
+        "sagemaker_submit_directory {} not found locally; S3 download is the "
+        "platform's responsibility".format(submit_dir)
+    )
+
+
+def run_script_mode():
+    """Execute the user-supplied training script as a subprocess."""
+    train_config = _read_json(os.getenv(constants.SM_INPUT_TRAINING_CONFIG_FILE))
+    program = train_config.get("sagemaker_program") or os.environ.get("SAGEMAKER_PROGRAM")
+    code_dir = _stage_user_module(train_config) or os.environ.get(
+        "SAGEMAKER_SUBMIT_DIRECTORY", "/opt/ml/code"
+    )
+    script = os.path.join(code_dir, program)
+    if not os.path.exists(script):
+        raise exc.UserError("User entry point {} does not exist".format(script))
+
+    # expose hyperparameters the way sagemaker-containers did
+    env = dict(os.environ)
+    hps = {
+        k: v for k, v in train_config.items() if not k.startswith("sagemaker_")
+    }
+    env["SM_HPS"] = json.dumps(hps)
+    env.setdefault("SM_MODEL_DIR", os.getenv(constants.SM_MODEL_DIR, "/opt/ml/model"))
+    args = [sys.executable, script]
+    for key, value in sorted(hps.items()):
+        args.extend(["--{}".format(key), str(value)])
+    logger.info("Invoking user training script: %s", " ".join(args))
+    result = subprocess.run(args, env=env, cwd=code_dir)
+    if result.returncode != 0:
+        raise exc.UserError(
+            "User script exited with non-zero status {}".format(result.returncode)
+        )
+
+
+def train():
+    train_config = _read_json(os.getenv(constants.SM_INPUT_TRAINING_CONFIG_FILE))
+    if train_config.get("sagemaker_program") or os.environ.get("SAGEMAKER_PROGRAM"):
+        logger.info("Invoking user training script.")
+        run_script_mode()
+    else:
+        logger.info("Running XGBoost Sagemaker in algorithm mode")
+        run_algorithm_mode()
+
+
+def _write_failure_file(message):
+    try:
+        os.makedirs(os.path.dirname(FAILURE_FILE), exist_ok=True)
+        with open(FAILURE_FILE, "w") as f:
+            f.write(message)
+    except OSError:
+        pass
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    try:
+        train()
+    except exc.BaseToolkitError as e:
+        logger.exception("Training failed")
+        _write_failure_file(e.public_failure_message())
+        sys.exit(1)
+    except Exception as e:  # unclassified: our bug
+        logger.exception("Training failed")
+        _write_failure_file(exc.convert_to_algorithm_error(e).public_failure_message())
+        sys.exit(1)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,326 @@
+"""Serving tests: real HTTP server over a socket, reference-fixture models.
+
+Coverage model: reference test/unit/algorithm_mode/test_serve(_utils).py +
+the MME lifecycle from test/integration/local/test_multiple_model_endpoint.py
+— but against our threaded WSGI server with the XLA predict kernel.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import Forest, train
+from sagemaker_xgboost_container_tpu.serving import serve_utils
+from sagemaker_xgboost_container_tpu.serving.app import ScoringService, make_app
+from sagemaker_xgboost_container_tpu.serving.mme import make_mme_app
+from tests.util_ports import free_port
+
+ABALONE_MODELS = "/root/reference/test/resources/abalone/models"
+REF_MODELS = "/root/reference/test/resources/models"
+
+
+@pytest.fixture(scope="module")
+def abalone_model_dir(tmp_path_factory):
+    """Train a small abalone model into a model dir."""
+    from sagemaker_xgboost_container_tpu.data.readers import get_data_matrix
+
+    dm = get_data_matrix("/root/reference/test/resources/abalone/data/train", "libsvm")
+    forest = train(
+        {"objective": "reg:squarederror", "max_depth": 4}, dm, num_boost_round=8
+    )
+    model_dir = tmp_path_factory.mktemp("model")
+    forest.save_model(str(model_dir / "xgboost-model"))
+    return str(model_dir)
+
+
+def _serve(app):
+    """Start the threaded WSGI server on a free port; return base URL."""
+    from wsgiref.simple_server import make_server
+
+    from sagemaker_xgboost_container_tpu.serving.server import (
+        _QuietHandler,
+        _ThreadedWSGIServer,
+    )
+
+    port = free_port()
+    httpd = make_server(
+        "127.0.0.1", port, app, server_class=_ThreadedWSGIServer, handler_class=_QuietHandler
+    )
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return "http://127.0.0.1:{}".format(port), httpd
+
+
+def _request(url, method="GET", data=None, headers=None):
+    req = urllib.request.Request(url, data=data, method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+LIBSVM_PAYLOAD = b"1:2 2:0.74 3:0.6 4:0.195 5:1.974 6:0.598 7:0.4085 8:0.71"
+CSV_PAYLOAD = b"2,0.74,0.6,0.195,1.974,0.598,0.4085,0.71,0.5"
+
+
+class TestSingleModelEndpoint:
+    @pytest.fixture(autouse=True, scope="class")
+    def _server(self, request, abalone_model_dir):
+        app = make_app(ScoringService(abalone_model_dir))
+        base, httpd = _serve(app)
+        request.cls.base = base
+        yield
+        httpd.shutdown()
+
+    def test_ping(self):
+        status, _, _ = _request(self.base + "/ping")
+        assert status == 200
+
+    def test_execution_parameters(self):
+        status, body, _ = _request(self.base + "/execution-parameters")
+        assert status == 200
+        params = json.loads(body)
+        assert params["BatchStrategy"] == "MULTI_RECORD"
+        assert params["MaxPayloadInMB"] == 6
+
+    def test_invocations_libsvm_csv_out(self):
+        status, body, _ = _request(
+            self.base + "/invocations",
+            method="POST",
+            data=LIBSVM_PAYLOAD,
+            headers={"Content-Type": "text/libsvm"},
+        )
+        assert status == 200, body
+        value = float(body.decode().strip())
+        assert 0 < value < 30  # abalone ring count territory
+
+    def test_invocations_csv_json_out(self):
+        status, body, _ = _request(
+            self.base + "/invocations",
+            method="POST",
+            data=CSV_PAYLOAD[: CSV_PAYLOAD.rfind(b",")],  # 8 features
+            headers={"Content-Type": "text/csv", "Accept": "application/json"},
+        )
+        assert status == 200, body
+        doc = json.loads(body)
+        assert "predictions" in doc and "score" in doc["predictions"][0]
+
+    def test_empty_payload_204(self):
+        status, _, _ = _request(
+            self.base + "/invocations",
+            method="POST",
+            data=b"",
+            headers={"Content-Type": "text/csv"},
+        )
+        assert status == 204
+
+    def test_bad_content_type_415(self):
+        status, _, _ = _request(
+            self.base + "/invocations",
+            method="POST",
+            data=b"<xml/>",
+            headers={"Content-Type": "application/xml"},
+        )
+        assert status == 415
+
+    def test_bad_accept_406(self):
+        status, _, _ = _request(
+            self.base + "/invocations",
+            method="POST",
+            data=LIBSVM_PAYLOAD,
+            headers={"Content-Type": "text/libsvm", "Accept": "application/x-npz"},
+        )
+        assert status == 406
+
+    def test_multirow_csv(self):
+        rows = b"\n".join([CSV_PAYLOAD[: CSV_PAYLOAD.rfind(b",")]] * 5)
+        status, body, _ = _request(
+            self.base + "/invocations",
+            method="POST",
+            data=rows,
+            headers={"Content-Type": "text/csv"},
+        )
+        assert status == 200
+        assert len(body.decode().strip().split("\n")) == 5
+
+
+class TestReferenceModelServing:
+    """Models produced by real xgboost (pickle/UBJ/legacy binary) serve."""
+
+    @pytest.mark.parametrize(
+        "model_dir",
+        [
+            ABALONE_MODELS + "/libsvm_pickled",
+            REF_MODELS + "/saved_booster",
+            REF_MODELS + "/pickled_model",
+        ],
+    )
+    def test_load_and_predict(self, model_dir):
+        model, fmt = serve_utils.get_loaded_booster(model_dir)
+        n_feat = model.num_feature
+        X = np.random.RandomState(0).rand(4, n_feat).astype(np.float32)
+        dtest = DataMatrix(X)
+        preds = serve_utils.predict(model, fmt, dtest, "text/csv", model.objective_name)
+        assert np.asarray(preds).shape[0] == 4
+
+    def test_abalone_pickled_sane_predictions(self):
+        model, fmt = serve_utils.get_loaded_booster(ABALONE_MODELS + "/libsvm_pickled")
+        from sagemaker_xgboost_container_tpu.serving.encoder import libsvm_to_matrix
+
+        dtest = libsvm_to_matrix(LIBSVM_PAYLOAD).pad_features(model.num_feature)
+        preds = serve_utils.predict(model, fmt, dtest, "text/libsvm", model.objective_name)
+        assert 0 < float(np.asarray(preds)[0]) < 30
+
+
+class TestSelectableInference:
+    def test_binary_keys(self, monkeypatch):
+        rng = np.random.RandomState(0)
+        X = rng.randn(300, 3).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        forest = train(
+            {"objective": "binary:logistic", "max_depth": 3},
+            DataMatrix(X, labels=y),
+            num_boost_round=5,
+        )
+        preds = forest.predict(X[:4])
+        selected = serve_utils.get_selected_predictions(
+            preds,
+            ["predicted_label", "probability", "probabilities", "labels"],
+            "binary:logistic",
+        )
+        assert len(selected) == 4
+        for row in selected:
+            assert row["predicted_label"] in (0, 1)
+            assert 0 <= row["probability"] <= 1
+            assert len(row["probabilities"]) == 2
+            assert row["labels"] == [0, 1]
+
+    def test_invalid_keys_get_nan(self):
+        selected = serve_utils.get_selected_predictions(
+            np.asarray([1.5]), ["predicted_score", "probabilities"], "reg:squarederror"
+        )
+        assert selected[0]["predicted_score"] == 1.5
+        assert np.isnan(selected[0]["probabilities"])
+
+    def test_encode_csv_and_jsonlines(self):
+        preds = [
+            {"predicted_label": 1, "probabilities": [0.4, 0.6]},
+            {"predicted_label": 0, "probabilities": [0.9, 0.1]},
+        ]
+        csv_out = serve_utils.encode_selected_predictions(
+            preds, ["predicted_label", "probabilities"], "text/csv"
+        )
+        assert csv_out.splitlines()[0] == '1,"[0.4, 0.6]"'
+        jl = serve_utils.encode_selected_predictions(
+            preds, ["predicted_label", "probabilities"], "application/jsonlines"
+        )
+        assert json.loads(jl.splitlines()[0])["predicted_label"] == 1
+
+    def test_encode_recordio(self):
+        from sagemaker_xgboost_container_tpu.data.recordio import iter_records, record_pb2
+
+        preds = [{"predicted_label": 1, "probabilities": [0.4, 0.6]}]
+        buf = serve_utils.encode_selected_predictions(
+            preds, ["predicted_label", "probabilities"], "application/x-recordio-protobuf"
+        )
+        records = list(iter_records(buf))
+        assert len(records) == 1
+        rec = record_pb2.Record()
+        rec.ParseFromString(records[0])
+        assert list(rec.label["probabilities"].float32_tensor.values) == pytest.approx(
+            [0.4, 0.6]
+        )
+
+    def test_selectable_end_to_end_http(self, monkeypatch, tmp_path):
+        rng = np.random.RandomState(0)
+        X = rng.randn(300, 3).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        forest = train(
+            {"objective": "binary:logistic", "max_depth": 3},
+            DataMatrix(X, labels=y),
+            num_boost_round=5,
+        )
+        forest.save_model(str(tmp_path / "xgboost-model"))
+        monkeypatch.setenv("SAGEMAKER_INFERENCE_OUTPUT", "predicted_label,probability")
+        app = make_app(ScoringService(str(tmp_path)))
+        base, httpd = _serve(app)
+        try:
+            status, body, _ = _request(
+                base + "/invocations",
+                method="POST",
+                data=b"0.5,0.1,0.2\n-2.0,0.0,0.0",
+                headers={"Content-Type": "text/csv", "Accept": "application/json"},
+            )
+            assert status == 200, body
+            doc = json.loads(body)
+            assert set(doc["predictions"][0]) == {"predicted_label", "probability"}
+        finally:
+            httpd.shutdown()
+
+
+class TestMultiModelEndpoint:
+    def test_lifecycle(self, abalone_model_dir):
+        app = make_mme_app()
+        base, httpd = _serve(app)
+        try:
+            status, body, _ = _request(base + "/models")
+            assert status == 200 and json.loads(body)["models"] == []
+
+            payload = json.dumps(
+                {"model_name": "abalone", "url": abalone_model_dir}
+            ).encode()
+            status, body, _ = _request(
+                base + "/models",
+                method="POST",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            assert status == 200, body
+
+            # duplicate load -> 409
+            status, _, _ = _request(
+                base + "/models",
+                method="POST",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            assert status == 409
+
+            status, body, _ = _request(base + "/models")
+            assert json.loads(body)["models"][0]["modelName"] == "abalone"
+
+            status, body, _ = _request(
+                base + "/models/abalone/invoke",
+                method="POST",
+                data=LIBSVM_PAYLOAD,
+                headers={"Content-Type": "text/libsvm"},
+            )
+            assert status == 200, body
+            assert 0 < float(body.decode().strip()) < 30
+
+            status, _, _ = _request(base + "/models/abalone", method="DELETE")
+            assert status == 200
+            status, _, _ = _request(
+                base + "/models/abalone/invoke",
+                method="POST",
+                data=LIBSVM_PAYLOAD,
+                headers={"Content-Type": "text/libsvm"},
+            )
+            assert status == 404
+        finally:
+            httpd.shutdown()
+
+    def test_unknown_model_404(self):
+        app = make_mme_app()
+        base, httpd = _serve(app)
+        try:
+            status, _, _ = _request(base + "/models/ghost")
+            assert status == 404
+        finally:
+            httpd.shutdown()

@@ -1,0 +1,205 @@
+"""End-to-end training-entrypoint tests.
+
+Fabricates the SageMaker filesystem contract in a tempdir (the reference's
+local_mode.py:371-396 trick, without Docker) and runs the real `train`
+entrypoint in a subprocess, asserting on produced model files and the HPO
+stdout-regex contract.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ABALONE = "/root/reference/test/resources/abalone/data"
+
+
+def _sm_env(tmp_path, hyperparameters, channels, train_dir, val_dir=None, hosts=None):
+    conf = tmp_path / "input" / "config"
+    conf.mkdir(parents=True)
+    model_dir = tmp_path / "model"
+    output_dir = tmp_path / "output" / "data"
+    model_dir.mkdir()
+    output_dir.mkdir(parents=True)
+    (conf / "hyperparameters.json").write_text(json.dumps(hyperparameters))
+    (conf / "inputdataconfig.json").write_text(json.dumps(channels))
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "SM_INPUT_TRAINING_CONFIG_FILE": str(conf / "hyperparameters.json"),
+            "SM_INPUT_DATA_CONFIG_FILE": str(conf / "inputdataconfig.json"),
+            "SM_CHECKPOINT_CONFIG_FILE": str(conf / "checkpointconfig.json"),
+            "SM_CHANNEL_TRAIN": train_dir,
+            "SM_MODEL_DIR": str(model_dir),
+            "SM_OUTPUT_DATA_DIR": str(output_dir),
+            "SM_HOSTS": json.dumps(hosts or ["algo-1"]),
+            "SM_CURRENT_HOST": (hosts or ["algo-1"])[0],
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO,
+        }
+    )
+    if val_dir:
+        env["SM_CHANNEL_VALIDATION"] = val_dir
+    return env, model_dir, output_dir
+
+
+def _run_train(env):
+    return subprocess.run(
+        [sys.executable, "-m", "sagemaker_xgboost_container_tpu.training.entry"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+
+
+LIBSVM_CHANNELS = {
+    "train": {
+        "ContentType": "libsvm",
+        "TrainingInputMode": "File",
+        "S3DistributionType": "FullyReplicated",
+    },
+    "validation": {
+        "ContentType": "libsvm",
+        "TrainingInputMode": "File",
+        "S3DistributionType": "FullyReplicated",
+    },
+}
+
+
+@pytest.mark.e2e
+def test_abalone_end_to_end(tmp_path):
+    env, model_dir, _ = _sm_env(
+        tmp_path,
+        {
+            "num_round": "10",
+            "objective": "reg:squarederror",
+            "max_depth": "4",
+            "eval_metric": "rmse",
+        },
+        LIBSVM_CHANNELS,
+        ABALONE + "/train",
+        ABALONE + "/validation",
+    )
+    result = _run_train(env)
+    assert result.returncode == 0, result.stderr[-3000:]
+    assert (model_dir / "xgboost-model").exists()
+    # HPO scrape contract: tab-separated eval lines for all 10 rounds
+    regex = re.compile(r".*\[[0-9]+\].*\tvalidation-rmse:(\S+)")
+    matches = [m for m in map(regex.match, result.stdout.splitlines()) if m]
+    assert len(matches) == 10, result.stdout[-2000:]
+    # model learns: rmse decreases
+    assert float(matches[-1].group(1)) < float(matches[0].group(1))
+    # model file is valid xgboost JSON loadable by our Forest
+    from sagemaker_xgboost_container_tpu.models import Forest
+
+    forest = Forest.load_model(str(model_dir / "xgboost-model"))
+    assert forest.num_boosted_rounds == 10
+
+
+@pytest.mark.e2e
+def test_kfold_cv_end_to_end(tmp_path):
+    env, model_dir, output_dir = _sm_env(
+        tmp_path,
+        {
+            "num_round": "5",
+            "objective": "reg:squarederror",
+            "max_depth": "3",
+            "_kfold": "3",
+            "_num_cv_round": "2",
+        },
+        LIBSVM_CHANNELS,
+        ABALONE + "/train",
+        ABALONE + "/validation",
+    )
+    result = _run_train(env)
+    assert result.returncode == 0, result.stderr[-3000:]
+    # k*r = 6 models
+    models = sorted(p.name for p in model_dir.iterdir())
+    assert models == ["xgboost-model-{}".format(i) for i in range(6)], models
+    preds = np.loadtxt(str(output_dir / "predictions.csv"), delimiter=",")
+    assert preds.shape[1] == 2  # y_true, mean prediction
+
+
+@pytest.mark.e2e
+def test_checkpoint_resume(tmp_path):
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    conf_extra = {"LocalPath": str(ckpt_dir)}
+    env, model_dir, _ = _sm_env(
+        tmp_path,
+        {"num_round": "8", "max_depth": "3", "eval_metric": "rmse"},
+        LIBSVM_CHANNELS,
+        ABALONE + "/train",
+        ABALONE + "/validation",
+    )
+    ckpt_conf = tmp_path / "input" / "config" / "checkpointconfig.json"
+    ckpt_conf.write_text(json.dumps(conf_extra))
+    result = _run_train(env)
+    assert result.returncode == 0, result.stderr[-3000:]
+    ckpts = sorted(os.listdir(ckpt_dir))
+    # max_to_keep = 5 retention
+    assert len(ckpts) == 5, ckpts
+    assert "xgboost-checkpoint.7" in ckpts
+
+    # resume: delete the last checkpoints, rerun — should continue, not restart
+    for name in ("xgboost-checkpoint.6", "xgboost-checkpoint.7"):
+        os.remove(str(ckpt_dir / name))
+    result2 = _run_train(env)
+    assert result2.returncode == 0, result2.stderr[-3000:]
+    lines = [l for l in result2.stdout.splitlines() if re.match(r"\[[0-9]+\]\t", l)]
+    # resumed from iteration 6: rounds 6 and 7 only
+    assert lines and lines[0].startswith("[6]"), lines[:3]
+
+
+@pytest.mark.e2e
+def test_user_error_writes_failure_file(tmp_path):
+    env, _, _ = _sm_env(
+        tmp_path,
+        {"num_round": "5", "tree_method": "gpu_hist"},
+        LIBSVM_CHANNELS,
+        ABALONE + "/train",
+    )
+    result = _run_train(env)
+    assert result.returncode == 1
+    assert "gpu_hist" in result.stderr
+
+
+@pytest.mark.e2e
+def test_csv_binary_logistic_with_accuracy_feval(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 3)
+    y = (X[:, 0] > 0).astype(int)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    rows = np.column_stack([y, X])
+    np.savetxt(str(data_dir / "train.csv"), rows, delimiter=",", fmt="%.6f")
+    channels = {
+        "train": {
+            "ContentType": "text/csv",
+            "TrainingInputMode": "File",
+            "S3DistributionType": "FullyReplicated",
+        }
+    }
+    env, model_dir, _ = _sm_env(
+        tmp_path,
+        {
+            "num_round": "8",
+            "objective": "binary:logistic",
+            "eval_metric": "logloss,accuracy",
+        },
+        channels,
+        str(data_dir),
+    )
+    result = _run_train(env)
+    assert result.returncode == 0, result.stderr[-3000:]
+    # native metric and sklearn custom metric both on the eval line
+    assert re.search(r"\ttrain-logloss:\S+", result.stdout)
+    assert re.search(r"\ttrain-accuracy:\S+", result.stdout)
+    assert (model_dir / "xgboost-model").exists()

@@ -1,0 +1,13 @@
+"""Spawn-safe helpers for multi-process cluster tests."""
+
+from sagemaker_xgboost_container_tpu.parallel.distributed import Cluster
+
+HOSTS = ["127.0.0.1", "localhost"]
+
+
+def sync_worker(host, q, port):
+    cluster = Cluster(HOSTS, host, port=port)
+    out = cluster.synchronize(
+        {"host": host, "include_in_training": host != "localhost"}
+    )
+    q.put((host, out))

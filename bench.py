@@ -53,6 +53,7 @@ def main():
         "eta": 0.2,
         "tree_method": "hist",
         "max_bin": 256,
+        "_rounds_per_dispatch": int(os.getenv("BENCH_ROUNDS_PER_DISPATCH", "10")),
     }
     config = TrainConfig(params)
     forest = Forest(
@@ -62,19 +63,21 @@ def main():
     )
     session = _TrainingSession(config, dtrain, [], forest)
 
-    for _ in range(WARMUP_ROUNDS):
-        session.run_round()
-
     import jax
 
+    done = 0
+    while done < WARMUP_ROUNDS:
+        done += len(session.run_rounds())
     jax.block_until_ready(session.margins)
+
     start = time.perf_counter()
-    for _ in range(BENCH_ROUNDS):
-        session.run_round()
+    done = 0
+    while done < BENCH_ROUNDS:
+        done += len(session.run_rounds())
     jax.block_until_ready(session.margins)
     elapsed = time.perf_counter() - start
 
-    rounds_per_sec = BENCH_ROUNDS / elapsed
+    rounds_per_sec = done / elapsed
     print(
         json.dumps(
             {

@@ -439,6 +439,14 @@ def initialize(metrics):
         IntegerHyperparameter(
             name="_num_devices", range=Interval(min_closed=1), required=False, tunable=False
         ),
+        # TPU-internal: build K trees per device dispatch (quiet runs only;
+        # forced back to 1 when eval sets need per-round metrics).
+        IntegerHyperparameter(
+            name="_rounds_per_dispatch",
+            range=Interval(min_closed=1),
+            required=False,
+            tunable=False,
+        ),
     )
 
     hps.declare_alias("eta", "learning_rate")

@@ -31,7 +31,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..data.binning import bin_matrix
 from ..ops.ranking import build_group_layout, lambdarank_grad_hess
-from ..ops.tree_build import build_tree, predict_binned
+from ..ops.tree_build import (
+    build_tree,
+    pack_tree,
+    predict_binned,
+    tree_from_packed,
+    unpack_tree,
+)
 from ..toolkit import exceptions as exc
 from . import eval_metrics
 from . import objectives as objectives_mod
@@ -76,6 +82,9 @@ class TrainConfig:
         self.monotone_constraints = p.get("monotone_constraints")
         self.eval_metric = p.get("eval_metric")
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1) or 1)
+        self.booster = p.get("booster", "gbtree")
+        # internal: build K trees per device dispatch (only without eval sets)
+        self.rounds_per_dispatch = int(p.get("_rounds_per_dispatch", 1) or 1)
         self.objective_params = p
         if self.objective == "count:poisson" and "max_delta_step" not in p:
             self.max_delta_step = 0.7
@@ -125,6 +134,13 @@ class _TrainingSession:
             raise exc.UserError(
                 "Distributed training for ranking objectives is not supported yet; "
                 "run ranking jobs on a single host."
+            )
+        if self.objective.name == "survival:cox" and mesh is not None:
+            # Cox risk sets span the whole dataset; shard-local
+            # argsort/cumsum would silently compute wrong gradients
+            raise exc.UserError(
+                "Distributed training for survival:cox is not supported yet; "
+                "run Cox regression jobs on a single host."
             )
         if self.is_ranking:
             if dtrain.groups is None:
@@ -189,6 +205,14 @@ class _TrainingSession:
                 self.eval_margins.append(jnp.full(eshape, base, jnp.float32))
 
         self.rng = jax.random.PRNGKey(config.seed)
+
+        self.rounds_per_dispatch = max(1, config.rounds_per_dispatch)
+        if self.rounds_per_dispatch > 1 and self.eval_sets:
+            logger.warning(
+                "_rounds_per_dispatch > 1 needs per-round eval margins; falling "
+                "back to 1 because eval sets are attached."
+            )
+            self.rounds_per_dispatch = 1
 
         monotone = np.zeros(dtrain.num_col, np.int32)
         if config.monotone_constraints:
@@ -289,14 +313,43 @@ class _TrainingSession:
             stacked = jax.tree_util.tree_map(
                 lambda *leaves: jnp.stack(leaves), *trees
             ) if num_parallel > 1 else trees[0]
-            return stacked, margins
+            # pack inside the program: the host pulls ONE array per dispatch
+            return pack_tree(stacked), margins
 
+        K = self.rounds_per_dispatch
+        colsample = cfg.colsample_bytree
+        d = self.train_binned.num_col
+
+        def multi_round(bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone):
+            # lax.scan so the round body is compiled ONCE regardless of K
+            k_features = max(1, int(round(colsample * d)))
+
+            def body(carry, j):
+                margins_c = carry
+                rng_j = jax.random.fold_in(rng, j)
+                if colsample < 1.0:
+                    # same exactly-k-without-replacement draw as the host path
+                    chosen = jax.random.permutation(
+                        jax.random.fold_in(rng_j, 777), d
+                    )[:k_features]
+                    mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
+                else:
+                    mask = feature_mask
+                packed, margins_c = one_round(
+                    bins, margins_c, labels, weights, num_cuts, rng_j, mask, monotone
+                )
+                return margins_c, packed
+
+            margins, packed_all = jax.lax.scan(body, margins, jnp.arange(K))
+            return packed_all, margins
+
+        fn = one_round if K == 1 else multi_round
         if self.mesh is None:
-            return jax.jit(one_round, donate_argnums=(1,))
+            return jax.jit(fn, donate_argnums=(1,))
 
         margin_spec = P("data") if num_group == 1 else P("data", None)
         mapped = shard_map(
-            one_round,
+            fn,
             mesh=self.mesh,
             in_specs=(
                 P("data", None),   # bins
@@ -319,7 +372,8 @@ class _TrainingSession:
         num_group = self.num_group
         num_parallel = cfg.num_parallel_tree
 
-        def apply_tree(tree, bins, margins):
+        def apply_tree(packed, bins, margins):
+            tree = tree_from_packed(packed)
             if num_group == 1:
                 if num_parallel > 1:
                     delta = jax.vmap(
@@ -346,7 +400,8 @@ class _TrainingSession:
         return jax.jit(mapped, donate_argnums=(2,))
 
     # ---------------------------------------------------------------- round
-    def run_round(self):
+    def run_rounds(self):
+        """One device dispatch -> list of rounds_per_dispatch host tree dicts."""
         self.rng, sub, colrng = jax.random.split(self.rng, 3)
         d = self.bins.shape[1]
         if self.config.colsample_bytree < 1.0:
@@ -355,7 +410,7 @@ class _TrainingSession:
             feature_mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
         else:
             feature_mask = jnp.ones(d, jnp.float32)
-        tree, self.margins = self._round_fn(
+        packed, self.margins = self._round_fn(
             self.bins,
             self.margins,
             self.labels,
@@ -365,12 +420,15 @@ class _TrainingSession:
             feature_mask,
             self.monotone,
         )
-        for i in range(len(self.eval_sets)):
-            if self.eval_margins[i] is not None:
-                self.eval_margins[i] = self._apply_fn(
-                    tree, self.eval_bins[i], self.eval_margins[i]
-                )
-        return jax.tree_util.tree_map(np.asarray, tree)
+        if self.rounds_per_dispatch == 1:
+            for i in range(len(self.eval_sets)):
+                if self.eval_margins[i] is not None:
+                    self.eval_margins[i] = self._apply_fn(
+                        packed, self.eval_bins[i], self.eval_margins[i]
+                    )
+            return [unpack_tree(np.asarray(packed))]
+        packed_np = np.asarray(packed)  # ONE transfer for K rounds
+        return [unpack_tree(packed_np[j]) for j in range(packed_np.shape[0])]
 
     # ----------------------------------------------------------------- eval
     def margins_for(self, index):
@@ -428,6 +486,17 @@ def train(
     config = TrainConfig(params)
     callbacks = list(callbacks or [])
 
+    if config.booster == "gblinear":
+        if xgb_model is not None:
+            raise exc.UserError(
+                "Continuing gblinear training from a checkpoint is not supported yet."
+            )
+        from .gblinear import train_linear
+
+        return train_linear(
+            config, dtrain, num_boost_round, evals=evals, feval=feval, callbacks=callbacks
+        )
+
     if xgb_model is None:
         forest = Forest(
             objective_name=config.objective,
@@ -456,6 +525,13 @@ def train(
         raise exc.UserError("feature_names mismatch between checkpoint and data")
     forest.num_feature = max(forest.num_feature, dtrain.num_col)
 
+    if config.booster == "dart":
+        from .dart import train_dart
+
+        return train_dart(
+            config, forest, dtrain, list(evals), feval, callbacks, num_boost_round
+        )
+
     session = _TrainingSession(config, dtrain, list(evals), forest, mesh=mesh)
     metric_names = _eval_metric_names(config, session.objective)
 
@@ -463,45 +539,51 @@ def train(
         if hasattr(cb, "before_training"):
             forest = cb.before_training(forest) or forest
 
+    def _trees_for_round(arrs):
+        if session.num_group > 1:
+            return (
+                [
+                    compact_padded_tree({k: v[c] for k, v in arrs.items()}, session.cuts)
+                    for c in range(session.num_group)
+                ],
+                list(range(session.num_group)),
+            )
+        if config.num_parallel_tree > 1:
+            return (
+                [
+                    compact_padded_tree({k: v[t] for k, v in arrs.items()}, session.cuts)
+                    for t in range(config.num_parallel_tree)
+                ],
+                [0] * config.num_parallel_tree,
+            )
+        return [compact_padded_tree(arrs, session.cuts)], [0]
+
     evals_log = {}
     start_round = forest.num_boosted_rounds
+    end_round = start_round + num_boost_round
+    rnd = start_round
     stop = False
-    for rnd in range(start_round, start_round + num_boost_round):
-        tree_np = session.run_round()
+    while rnd < end_round and not stop:
+        for tree_np in session.run_rounds():
+            if rnd >= end_round:
+                break  # trees past the requested count are discarded
+            trees, info = _trees_for_round(tree_np)
+            forest.append_round(trees, info)
 
-        def _trees_for_round(arrs):
-            if session.num_group > 1:
-                return (
-                    [
-                        compact_padded_tree({k: v[c] for k, v in arrs.items()}, session.cuts)
-                        for c in range(session.num_group)
-                    ],
-                    list(range(session.num_group)),
-                )
-            if config.num_parallel_tree > 1:
-                return (
-                    [
-                        compact_padded_tree({k: v[t] for k, v in arrs.items()}, session.cuts)
-                        for t in range(config.num_parallel_tree)
-                    ],
-                    [0] * config.num_parallel_tree,
-                )
-            return [compact_padded_tree(arrs, session.cuts)], [0]
+            results = (
+                session.evaluate(metric_names, feval=feval) if session.eval_sets else []
+            )
+            for data_name, metric_name, value in results:
+                evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
 
-        trees, info = _trees_for_round(tree_np)
-        forest.append_round(trees, info)
-
-        results = session.evaluate(metric_names, feval=feval) if session.eval_sets else []
-        for data_name, metric_name, value in results:
-            evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
-
-        for cb in callbacks:
-            if hasattr(cb, "after_iteration") and cb.after_iteration(
-                forest, rnd, evals_log
-            ):
-                stop = True
-        if stop:
-            break
+            for cb in callbacks:
+                if hasattr(cb, "after_iteration") and cb.after_iteration(
+                    forest, rnd, evals_log
+                ):
+                    stop = True
+            rnd += 1
+            if stop:
+                break
 
     for cb in callbacks:
         if hasattr(cb, "after_training"):

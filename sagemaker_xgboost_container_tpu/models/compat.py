@@ -289,6 +289,16 @@ def _install_xgboost_stub():
     sys.modules["xgboost.sklearn"] = sklearn_mod
 
 
+def _model_from_dict(doc):
+    """Dispatch a decoded model document by booster type."""
+    name = doc.get("learner", {}).get("gradient_booster", {}).get("name", "gbtree")
+    if name == "gblinear":
+        from .gblinear import LinearModel
+
+        return LinearModel.from_dict(doc)
+    return Forest.from_dict(doc)
+
+
 def _forest_from_raw(raw):
     """Dispatch a raw model buffer by magic."""
     raw = bytes(raw)
@@ -307,9 +317,9 @@ def _forest_from_raw(raw):
         return forest
     head = raw.lstrip()[:1]
     if head == b"{" and raw[1:2] not in (b"L", b"l", b"i", b"U", b"I", b"#", b"$"):
-        return Forest.load_json(raw.decode("utf-8"))
+        return _model_from_dict(json.loads(raw.decode("utf-8")))
     if raw[:1] == b"{":
-        return Forest.from_dict(decode_ubjson(raw))
+        return _model_from_dict(decode_ubjson(raw))
     return _parse_legacy_binary(raw)
 
 

@@ -1,0 +1,196 @@
+"""DART booster: dropout-regularized boosting rounds.
+
+The reference validates booster=dart with sample_type/normalize_type/
+rate_drop/one_drop/skip_drop (hyperparameter_validation.py:272-276) and
+delegates to libxgboost's dart updater. Algorithm (Rashmi & Gilad-Bachrach,
+mirrored from xgboost's dart semantics):
+
+per round: sample a dropped subset D of existing trees (each kept tree with
+prob rate_drop; if empty and one_drop, force one; with prob skip_drop no
+dropout at all) -> compute gradients at margins *without* D -> fit the new
+tree -> rescale: normalize_type=tree: new *= eta/(k+eta), dropped *= k/(k+eta);
+forest: new *= eta/(1+eta), dropped *= 1/(1+eta).
+
+Per-tree train-row contributions are cached on device so "margins without D"
+is a subtraction, not a re-predict; dropped trees' cached contributions and
+host-side leaf values are rescaled in place (dart mutates history).
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.tree_build import build_tree
+from ..toolkit import exceptions as exc
+from .booster import _TrainingSession, _eval_metric_names
+from .forest import compact_padded_tree
+
+logger = logging.getLogger(__name__)
+
+
+def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round):
+    if config.num_class > 1:
+        raise exc.UserError("booster=dart with multi-class objectives is not supported yet.")
+    p = config.objective_params
+    rate_drop = float(p.get("rate_drop", 0.0))
+    skip_drop = float(p.get("skip_drop", 0.0))
+    one_drop = int(p.get("one_drop", 0))
+    sample_type = p.get("sample_type", "uniform")
+    normalize_type = p.get("normalize_type", "tree")
+    eta = config.eta
+
+    for cb in callbacks:
+        if getattr(cb, "save_best", False):
+            raise exc.UserError(
+                "early_stopping with save_best is not supported for booster=dart: "
+                "dropout rescales historical trees, so truncating to the best "
+                "iteration does not reproduce the best model."
+            )
+
+    session = _TrainingSession(config, dtrain, list(evals), forest)
+    metric_names = _eval_metric_names(config, session.objective)
+
+    # build trees with unit shrinkage; dart applies its own scaling
+    builder = jax.jit(
+        lambda bins, g, h, num_cuts, mask, rng: build_tree(
+            bins, g, h, num_cuts,
+            max_depth=config.max_depth,
+            num_bins=session.train_binned.num_bins,
+            reg_lambda=config.reg_lambda,
+            alpha=config.alpha,
+            gamma=config.gamma,
+            min_child_weight=config.min_child_weight,
+            eta=1.0,
+            max_delta_step=config.max_delta_step,
+            feature_mask=mask,
+            colsample_bylevel=config.colsample_bylevel,
+            rng=rng,
+        )
+    )
+    grad_fn = jax.jit(session.objective.grad_hess)
+
+    tree_contribs = []   # device [n] row contributions, current scaling
+    tree_weights = []    # current scale factor per tree (host floats)
+    rng = np.random.RandomState(config.seed)
+
+    if forest.trees:
+        # checkpoint resume: dropout must cover the checkpoint's trees too, so
+        # rebuild their per-row contributions (one stacked-kernel pass)
+        from ..ops.predict import _forest_margin
+
+        stacked = forest._stack(slice(0, len(forest.trees)))
+        depth = stacked.pop("depth")
+        leaf = _forest_margin(
+            *(jnp.asarray(stacked[k]) for k in (
+                "feature", "threshold", "default_left", "left", "right",
+                "is_leaf", "leaf_value",
+            )),
+            jnp.asarray(dtrain.features),
+            depth,
+        )  # [n, T]
+        for i in range(len(forest.trees)):
+            tree_contribs.append(leaf[:, i])
+            tree_weights.append(1.0)
+
+    evals_log = {}
+    stop = False
+    for rnd in range(num_boost_round):
+        # ---- sample dropout set -----------------------------------------
+        dropped = []
+        if tree_contribs and rng.uniform() >= skip_drop:
+            if sample_type == "weighted" and sum(tree_weights) > 0:
+                probs = np.asarray(tree_weights) / sum(tree_weights)
+                draws = rng.uniform(size=len(tree_contribs)) < rate_drop * probs * len(probs)
+            else:
+                draws = rng.uniform(size=len(tree_contribs)) < rate_drop
+            dropped = list(np.flatnonzero(draws))
+            if not dropped and one_drop:
+                dropped = [int(rng.randint(len(tree_contribs)))]
+
+        drop_sum = None
+        for i in dropped:
+            drop_sum = tree_contribs[i] if drop_sum is None else drop_sum + tree_contribs[i]
+        margins_used = session.margins - drop_sum if drop_sum is not None else session.margins
+
+        g, h = grad_fn(margins_used, session.labels, session.weights)
+
+        d = session.bins.shape[1]
+        if config.colsample_bytree < 1.0:
+            k = max(1, int(round(config.colsample_bytree * d)))
+            mask = np.zeros(d, np.float32)
+            mask[rng.choice(d, size=k, replace=False)] = 1.0
+        else:
+            mask = np.ones(d, np.float32)
+        if config.subsample < 1.0:
+            keep = (rng.uniform(size=session.bins.shape[0]) < config.subsample).astype(np.float32)
+            g, h = g * jnp.asarray(keep), h * jnp.asarray(keep)
+
+        tree, row_out = builder(
+            session.bins, g, h, session.num_cuts, jnp.asarray(mask),
+            jax.random.PRNGKey(rng.randint(2**31)),
+        )
+
+        # ---- dart normalization ------------------------------------------
+        k = len(dropped)
+        if k == 0:
+            new_scale, old_scale = eta, 1.0
+        elif normalize_type == "forest":
+            new_scale = eta / (1.0 + eta)
+            old_scale = 1.0 / (1.0 + eta)
+        else:  # "tree"
+            new_scale = eta / (k + eta)
+            old_scale = k / (k + eta)
+
+        new_contrib = row_out * new_scale
+        margins = margins_used + new_contrib
+        for i in dropped:
+            tree_contribs[i] = tree_contribs[i] * old_scale
+            tree_weights[i] *= old_scale
+            margins = margins + tree_contribs[i]
+            # rescale the stored tree's leaves (dart mutates history)
+            forest.trees[i].value *= old_scale
+        forest._stacked_cache = None
+        session.margins = margins
+        tree_contribs.append(new_contrib)
+        tree_weights.append(new_scale)
+
+        tree_np = jax.tree_util.tree_map(np.asarray, tree)
+        tree_np["leaf_value"] = tree_np["leaf_value"] * new_scale
+        tree_np["base_weight"] = tree_np["base_weight"] * new_scale
+        forest.append_round([compact_padded_tree(tree_np, session.cuts)], [0])
+
+        # ---- eval: dart predicts with the full (rescaled) forest ---------
+        results = []
+        if session.eval_sets:
+            for i, (name, dm, binned) in enumerate(session.eval_sets):
+                margin = (
+                    np.asarray(session.margins)[: session.n]
+                    if binned is session.train_binned
+                    else forest.predict_margin(dm.features)
+                )
+                preds = session.objective.margin_to_prediction(margin)
+                from . import eval_metrics
+
+                for metric in metric_names:
+                    value = eval_metrics.evaluate(
+                        metric, preds, dm.labels, dm.weights, groups=dm.groups
+                    )
+                    results.append((name, metric, value))
+                if feval is not None:
+                    for metric_name, value in feval(margin, dm):
+                        results.append((name, metric_name, value))
+        for data_name, metric_name, value in results:
+            evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
+
+        for cb in callbacks:
+            if hasattr(cb, "after_iteration") and cb.after_iteration(forest, rnd, evals_log):
+                stop = True
+        if stop:
+            break
+
+    for cb in callbacks:
+        if hasattr(cb, "after_training"):
+            forest = cb.after_training(forest) or forest
+    return forest

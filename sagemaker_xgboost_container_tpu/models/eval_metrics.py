@@ -142,6 +142,49 @@ def tweedie_nloglik(preds, labels, weights=None, rho=1.5):
     return float(np.sum(w * (-a + b)) / np.sum(w))
 
 
+def aft_nloglik(preds, labels, weights=None, dist="normal", sigma=1.0):
+    """AFT negative log-likelihood for uncensored (point-label) data.
+
+    preds are event-time predictions (exp(margin)); z = (log y - log pred)/sigma.
+    """
+    w = _w(weights, labels)
+    z = (np.log(np.maximum(labels, 1e-12)) - np.log(np.maximum(preds, 1e-12))) / sigma
+    if dist == "logistic":
+        nll = -(-z - 2.0 * np.log1p(np.exp(-z))) + np.log(sigma * np.maximum(labels, 1e-12))
+    elif dist == "extreme":
+        nll = -(z - np.exp(np.clip(z, -30, 30))) + np.log(sigma * np.maximum(labels, 1e-12))
+    else:  # normal
+        nll = 0.5 * z * z + np.log(
+            sigma * np.maximum(labels, 1e-12) * np.sqrt(2 * np.pi)
+        )
+    return float(np.sum(w * nll) / np.sum(w))
+
+
+def cox_nloglik(preds, labels, weights=None):
+    """Negative Breslow partial log-likelihood; labels<0 = censored at |t|,
+    preds are hazard ratios exp(margin)."""
+    w = _w(weights, labels)
+    abs_time = np.abs(labels)
+    event = (labels > 0).astype(np.float64)
+    order = np.argsort(-abs_time, kind="stable")
+    hz = np.maximum(np.asarray(preds, np.float64), 1e-300)[order] * w[order]
+    cum_risk = np.cumsum(hz)
+    ev = (event * w)[order]
+    ll = np.sum(ev * (np.log(hz) - np.log(np.maximum(cum_risk, 1e-300))))
+    n_events = max(ev.sum(), 1e-12)
+    return float(-ll / n_events)
+
+
+def interval_regression_accuracy(preds, labels, weights=None):
+    from ..toolkit import exceptions as exc
+
+    raise exc.UserError(
+        "Metric 'interval-regression-accuracy' requires interval-censored labels "
+        "(label_lower_bound/label_upper_bound), which the csv/libsvm data contract "
+        "cannot express; use 'aft-nloglik' instead."
+    )
+
+
 def _dcg_at(scores_sorted_labels, k):
     gains = (2.0**scores_sorted_labels - 1.0) / np.log2(np.arange(2, len(scores_sorted_labels) + 2))
     if k:
@@ -202,6 +245,9 @@ _SIMPLE = {
     "gamma-nloglik": gamma_nloglik,
     "gamma-deviance": gamma_deviance,
     "tweedie-nloglik": tweedie_nloglik,
+    "aft-nloglik": aft_nloglik,
+    "cox-nloglik": cox_nloglik,
+    "interval-regression-accuracy": interval_regression_accuracy,
 }
 
 _MULTI = {"merror": merror, "mlogloss": mlogloss}

@@ -361,7 +361,14 @@ class Forest:
     def from_dict(cls, doc):
         try:
             learner = doc["learner"]
-            model = learner["gradient_booster"]["model"]
+            gb = learner["gradient_booster"]
+            weight_drop = None
+            if gb.get("name") == "dart" or "gbtree" in gb:
+                # dart nests the tree model under "gbtree" and carries
+                # per-tree dropout scale factors in "weight_drop"
+                weight_drop = gb.get("weight_drop")
+                gb = gb["gbtree"]
+            model = gb["model"]
             lmp = learner["learner_model_param"]
             objective = learner["objective"]
         except (KeyError, ValueError, TypeError) as e:
@@ -380,6 +387,9 @@ class Forest:
         )
         forest.attributes = learner.get("attributes", {})
         forest.trees = [cls._tree_from_json(t) for t in model["trees"]]
+        if weight_drop:
+            for tree, scale in zip(forest.trees, weight_drop):
+                tree.value = tree.value * np.float32(scale)
         forest.tree_info = [int(v) for v in model.get("tree_info", [0] * len(forest.trees))]
         indptr = model.get("iteration_indptr")
         if indptr:

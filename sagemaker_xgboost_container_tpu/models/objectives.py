@@ -276,6 +276,90 @@ class SoftprobMulti(SoftmaxMulti):
         return e / e.sum(axis=1, keepdims=True)
 
 
+class SurvivalAft(Objective):
+    """survival:aft — accelerated failure time with point labels.
+
+    The SageMaker data contract carries a single label column, so the
+    censoring interval degenerates to y_lower == y_upper == label
+    (uncensored); the distribution/scale hyperparameters
+    (aft_loss_distribution[_scale]) behave as in xgboost.
+    """
+
+    name = "survival:aft"
+    default_metric = "aft-nloglik"
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self.dist = str(self.params.get("aft_loss_distribution", "normal"))
+        self.sigma = float(self.params.get("aft_loss_distribution_scale", 1.0))
+
+    def validate_labels(self, labels):
+        if labels.size and (labels <= 0).any():
+            raise exc.UserError("survival:aft labels (event times) must be positive")
+
+    def base_margin(self, base_score):
+        return math.log(max(float(base_score), 1e-16))
+
+    def grad_hess(self, margin, label, weight):
+        s = self.sigma
+        z = (jnp.log(jnp.maximum(label, 1e-12)) - margin) / s
+        if self.dist == "normal":
+            g = -z / s
+            h = jnp.full_like(margin, 1.0 / (s * s))
+        elif self.dist == "logistic":
+            ez = jnp.exp(-jnp.abs(z))
+            sig = jnp.where(z >= 0, 1.0 / (1.0 + ez), ez / (1.0 + ez))
+            g = -(2.0 * sig - 1.0) / s
+            h = jnp.maximum(2.0 * sig * (1.0 - sig) / (s * s), _HESS_EPS)
+        else:  # extreme (Gumbel)
+            w = jnp.exp(jnp.clip(z, -30.0, 30.0))
+            g = (1.0 - w) / s
+            h = jnp.maximum(w / (s * s), _HESS_EPS)
+        return g * weight, h * weight
+
+    def margin_to_prediction(self, margin):
+        return np.exp(margin)
+
+
+class SurvivalCox(Objective):
+    """survival:cox — proportional-hazards partial likelihood.
+
+    Labels follow xgboost's convention: positive = event time (uncensored),
+    negative = |censoring time| (right-censored). Risk sets are evaluated via
+    cumulative sums over a host-precomputed time ordering captured at first
+    call (the label vector is static across rounds).
+    """
+
+    name = "survival:cox"
+    default_metric = "cox-nloglik"
+
+    def base_margin(self, base_score):
+        return 0.0
+
+    def grad_hess(self, margin, label, weight):
+        abs_time = jnp.abs(label)
+        is_event = (label > 0).astype(margin.dtype)
+        # risk set of i: rows with abs_time >= abs_time_i. Sort descending by
+        # time; cumulative sums give risk-set aggregates.
+        order = jnp.argsort(-abs_time)
+        inv = jnp.argsort(order)
+        exp_m = jnp.exp(margin - jnp.max(margin)) * weight
+        exp_sorted = exp_m[order]
+        cum_risk = jnp.cumsum(exp_sorted)[inv]          # sum over risk set of i
+        # accumulate, over events e with t_e <= t_i, of 1/risk(e) and 1/risk(e)^2
+        ev_sorted = (is_event * weight)[order]
+        inv_risk = ev_sorted[::-1] / cum_risk[order][::-1]
+        inv_risk2 = ev_sorted[::-1] / (cum_risk[order][::-1] ** 2)
+        cum_inv = jnp.cumsum(inv_risk)[::-1][inv]
+        cum_inv2 = jnp.cumsum(inv_risk2)[::-1][inv]
+        g = -is_event * weight + exp_m * cum_inv
+        h = jnp.maximum(exp_m * cum_inv - (exp_m**2) * cum_inv2, _HESS_EPS)
+        return g, h
+
+    def margin_to_prediction(self, margin):
+        return np.exp(margin)
+
+
 class LambdaRankObjective(Objective):
     """rank:pairwise / rank:ndcg / rank:map — LambdaMART gradients.
 
@@ -328,6 +412,8 @@ _REGISTRY = {
         TweedieRegression,
         SoftmaxMulti,
         SoftprobMulti,
+        SurvivalAft,
+        SurvivalCox,
         LambdaRankObjective,
         RankNdcg,
         RankMap,

@@ -144,6 +144,53 @@ def build_tree(
     return tree, row_out
 
 
+_TREE_FIELDS = (
+    "feature",
+    "bin",
+    "default_left",
+    "is_leaf",
+    "leaf_value",
+    "base_weight",
+    "gain",
+    "sum_hess",
+)
+
+
+def pack_tree(tree):
+    """Tree dict -> one f32 [8, max_nodes] array (single D2H transfer)."""
+    return jnp.stack([tree[k].astype(jnp.float32) for k in _TREE_FIELDS])
+
+
+def tree_from_packed(packed):
+    """Packed device array -> device tree dict (cheap casts, no transfer)."""
+    return {
+        "feature": packed[0].astype(jnp.int32),
+        "bin": packed[1].astype(jnp.int32),
+        "default_left": packed[2] > 0.5,
+        "is_leaf": packed[3] > 0.5,
+        "leaf_value": packed[4],
+        "base_weight": packed[5],
+        "gain": packed[6],
+        "sum_hess": packed[7],
+    }
+
+
+def unpack_tree(packed):
+    """Packed numpy array -> host tree dict with proper dtypes."""
+    import numpy as np
+
+    out = {}
+    for i, key in enumerate(_TREE_FIELDS):
+        arr = np.asarray(packed[i])
+        if key in ("feature", "bin"):
+            out[key] = arr.astype(np.int32)
+        elif key in ("default_left", "is_leaf"):
+            out[key] = arr.astype(bool)
+        else:
+            out[key] = arr.astype(np.float32)
+    return out
+
+
 def predict_binned(tree, bins, max_depth, num_bins):
     """Apply one trained (padded-layout) tree to binned rows -> margins.
 

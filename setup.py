@@ -1,0 +1,43 @@
+import os
+
+from setuptools import find_packages, setup
+
+
+def read(fname):
+    with open(os.path.join(os.path.dirname(__file__), fname)) as f:
+        return f.read()
+
+
+setup(
+    name="sagemaker_xgboost_container_tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native gradient-boosting training and serving container with the "
+        "capabilities of the SageMaker XGBoost container"
+    ),
+    long_description=read("README.md"),
+    long_description_content_type="text/markdown",
+    packages=find_packages(exclude=("tests",)),
+    package_data={"sagemaker_xgboost_container_tpu.data": ["record_pb2.py"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "numpy",
+        "scipy",
+        "pandas",
+        "pyarrow",
+        "scikit-learn",
+        "protobuf",
+    ],
+    entry_points={
+        "console_scripts": [
+            # the container CMDs (reference setup.py:34-38)
+            "train=sagemaker_xgboost_container_tpu.training.entry:main",
+            "serve=sagemaker_xgboost_container_tpu.serving.server:main",
+        ]
+    },
+    classifiers=[
+        "Programming Language :: Python :: 3",
+        "License :: OSI Approved :: Apache Software License",
+    ],
+)

@@ -1,0 +1,151 @@
+"""gblinear, dart, and survival-objective tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+from sagemaker_xgboost_container_tpu.models.compat import load_model_any_format
+from sagemaker_xgboost_container_tpu.models.eval_metrics import evaluate as eval_metric
+
+
+def _linear_data(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype(np.float32)
+    true_w = np.asarray([2.0, -1.0, 0.5, 0.0, 0.0, 3.0], np.float32)
+    y = X @ true_w + 1.5 + rng.randn(n).astype(np.float32) * 0.05
+    return X, y
+
+
+def test_gblinear_regression(tmp_path):
+    X, y = _linear_data()
+    dtrain = DataMatrix(X, labels=y)
+    model = train(
+        {"booster": "gblinear", "eta": 0.5, "lambda": 0.0, "alpha": 0.0},
+        dtrain,
+        num_boost_round=50,
+        evals=[(dtrain, "train")],
+    )
+    rmse = eval_metric("rmse", model.predict(X), y)
+    assert rmse < 0.2, rmse
+    # round-trips through xgboost gblinear JSON
+    path = str(tmp_path / "xgboost-model")
+    model.save_model(path)
+    loaded, fmt = load_model_any_format(path)
+    np.testing.assert_allclose(loaded.predict(X), model.predict(X), rtol=1e-5)
+    doc = json.loads(open(path).read())
+    assert doc["learner"]["gradient_booster"]["name"] == "gblinear"
+
+
+def test_gblinear_l1_sparsifies():
+    X, y = _linear_data()
+    dtrain = DataMatrix(X, labels=y)
+    model = train(
+        {"booster": "gblinear", "eta": 0.5, "alpha": 50.0, "lambda": 0.0},
+        dtrain,
+        num_boost_round=50,
+    )
+    # the two zero-coefficient features should be (near-)zeroed by L1
+    assert np.abs(model.weights[3:5]).max() < 0.05
+
+
+def test_gblinear_binary():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 4).astype(np.float32)
+    y = ((X @ np.asarray([1.0, -2.0, 0.5, 0.0], np.float32)) > 0).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    model = train(
+        {"booster": "gblinear", "objective": "binary:logistic", "eta": 0.5},
+        dtrain,
+        num_boost_round=60,
+    )
+    p = model.predict(X)
+    assert ((p > 0.5) == y).mean() > 0.95
+
+
+def test_dart_with_dropout_learns():
+    rng = np.random.RandomState(2)
+    X = rng.rand(1200, 5).astype(np.float32)
+    y = (10 * X[:, 0] + 5 * np.sin(6 * X[:, 1]) + X[:, 2]).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    model = train(
+        {
+            "booster": "dart",
+            "max_depth": 4,
+            "eta": 0.3,
+            "rate_drop": 0.2,
+            "seed": 7,
+        },
+        dtrain,
+        num_boost_round=25,
+        evals=[(dtrain, "train")],
+    )
+    assert len(model.trees) == 25
+    rmse = eval_metric("rmse", model.predict(X), y)
+    base = float(np.sqrt(np.mean((y - y.mean()) ** 2)))
+    assert rmse < 0.35 * base, (rmse, base)
+
+
+def test_dart_rate_drop_zero_matches_gbtree_shape():
+    X, y = _linear_data(400)
+    dtrain = DataMatrix(X, labels=y)
+    model = train(
+        {"booster": "dart", "max_depth": 3, "rate_drop": 0.0},
+        dtrain,
+        num_boost_round=5,
+    )
+    assert model.num_boosted_rounds == 5
+    # with no dropout, dart == plain boosting with eta scaling
+    gbtree = train(
+        {"booster": "gbtree", "max_depth": 3},
+        dtrain,
+        num_boost_round=5,
+    )
+    np.testing.assert_allclose(
+        model.predict(X), gbtree.predict(X), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_survival_aft():
+    rng = np.random.RandomState(3)
+    X = rng.rand(1500, 3).astype(np.float32)
+    t = np.exp(2.0 * X[:, 0] + 0.5 * X[:, 1] + rng.randn(1500) * 0.1).astype(np.float32)
+    dtrain = DataMatrix(X, labels=t)
+    model = train(
+        {
+            "objective": "survival:aft",
+            "aft_loss_distribution": "normal",
+            "aft_loss_distribution_scale": "1.0",
+            "max_depth": 3,
+            "base_score": "1.0",
+            "eval_metric": "rmse",
+        },
+        dtrain,
+        num_boost_round=30,
+    )
+    preds = model.predict(X)
+    assert (preds > 0).all()
+    corr = np.corrcoef(np.log(preds), np.log(t))[0, 1]
+    assert corr > 0.95, corr
+
+
+def test_survival_cox():
+    rng = np.random.RandomState(4)
+    n = 1000
+    X = rng.rand(n, 3).astype(np.float32)
+    hazard = np.exp(2.0 * X[:, 0] - 1.0 * X[:, 1])
+    t = rng.exponential(1.0 / hazard).astype(np.float32)
+    censored = rng.rand(n) < 0.2
+    labels = np.where(censored, -t, t).astype(np.float32)
+    dtrain = DataMatrix(X, labels=labels)
+    model = train(
+        {"objective": "survival:cox", "max_depth": 3, "eta": 0.1},
+        dtrain,
+        num_boost_round=30,
+    )
+    margin = model.predict(X, output_margin=True)
+    # higher predicted risk should correlate with the true hazard
+    corr = np.corrcoef(margin, np.log(hazard))[0, 1]
+    assert corr > 0.8, corr

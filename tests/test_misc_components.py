@@ -1,0 +1,117 @@
+"""Tests for metadata generation, handler services, round batching, logging."""
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+from sagemaker_xgboost_container_tpu.toolkit.metadata import generate_algorithm_spec
+
+
+def test_generate_algorithm_spec():
+    spec = generate_algorithm_spec("123.dkr.ecr.example/xgboost-tpu:latest")
+    ts = spec["TrainingSpecification"]
+    assert ts["TrainingImage"].endswith(":latest")
+    assert any(hp["Name"] == "num_round" for hp in ts["SupportedHyperParameters"])
+    assert any(ch["Name"] == "train" for ch in ts["TrainingChannels"])
+    assert any(
+        m["Name"] == "validation:rmse" for m in ts["MetricDefinitions"]
+    )
+    infer = spec["InferenceSpecification"]
+    assert "text/csv" in infer["SupportedContentTypes"]
+
+
+def test_rounds_per_dispatch_equivalence():
+    rng = np.random.RandomState(0)
+    X = rng.rand(600, 4).astype(np.float32)
+    y = (X[:, 0] * 3 + X[:, 1]).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    one = train({"max_depth": 3, "seed": 5}, dtrain, num_boost_round=6)
+    batched = train(
+        {"max_depth": 3, "seed": 5, "_rounds_per_dispatch": 3},
+        dtrain,
+        num_boost_round=6,
+    )
+    assert batched.num_boosted_rounds == 6
+    np.testing.assert_allclose(one.predict(X), batched.predict(X), rtol=1e-4, atol=1e-5)
+    # non-divisible count: extras are discarded
+    ragged = train(
+        {"max_depth": 3, "seed": 5, "_rounds_per_dispatch": 4},
+        dtrain,
+        num_boost_round=6,
+    )
+    assert ragged.num_boosted_rounds == 6
+
+
+def test_rounds_per_dispatch_falls_back_with_evals():
+    rng = np.random.RandomState(1)
+    X = rng.rand(300, 3).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    log = {}
+
+    class Recorder:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update(evals_log)
+            return False
+
+    train(
+        {"max_depth": 3, "_rounds_per_dispatch": 5},
+        dtrain,
+        num_boost_round=4,
+        evals=[(dtrain, "train")],
+        callbacks=[Recorder()],
+    )
+    # per-round metrics still produced for all 4 rounds
+    assert len(log["train"]["rmse"]) == 4
+
+
+def test_algorithm_handler_service(tmp_path):
+    rng = np.random.RandomState(2)
+    X = rng.rand(200, 3).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    forest = train({"max_depth": 3}, DataMatrix(X, labels=y), num_boost_round=3)
+    forest.save_model(str(tmp_path / "xgboost-model"))
+
+    from sagemaker_xgboost_container_tpu.serving.handler_service import (
+        AlgorithmHandlerService,
+    )
+
+    svc = AlgorithmHandlerService()
+    body, ctype = svc.handle(b"0.5,0.2,0.1\n0.9,0.8,0.7", "text/csv", "text/csv", str(tmp_path))
+    assert ctype == "text/csv"
+    assert len(body.splitlines()) == 2
+
+
+def test_user_module_handler_requires_model_fn(tmp_path):
+    from sagemaker_xgboost_container_tpu.serving.handler_service import (
+        UserModuleHandlerService,
+    )
+    from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+    svc = UserModuleHandlerService(user_module=None)
+    with pytest.raises(exc.UserError, match="model_fn"):
+        svc.handle(b"1,2", "text/csv", "text/csv", str(tmp_path))
+
+
+def test_user_module_handler_transform_fn(tmp_path):
+    import types
+
+    module = types.SimpleNamespace(
+        model_fn=lambda model_dir: "MODEL",
+        transform_fn=lambda model, payload, ctype, accept: ("custom:" + payload.decode(), "text/csv"),
+    )
+    from sagemaker_xgboost_container_tpu.serving.handler_service import (
+        UserModuleHandlerService,
+    )
+
+    svc = UserModuleHandlerService(user_module=module)
+    body, ctype = svc.handle(b"1,2", "text/csv", "text/csv", str(tmp_path))
+    assert body == "custom:1,2"
+
+
+def test_logging_config():
+    from sagemaker_xgboost_container_tpu.utils.logging_config import setup_main_logger
+
+    logger = setup_main_logger("x")
+    logger.info("hello")

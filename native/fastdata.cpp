@@ -1,0 +1,165 @@
+// Native data-plane: high-throughput LIBSVM tokenizer.
+//
+// The reference container's heavy ingestion ran through native code too
+// (libxgboost's parsers + MLIO, SURVEY.md §2.2): pure-Python tokenization of
+// multi-GB libsvm shards would dominate job start time. This library performs
+// the two-pass parse (count, then fill preallocated numpy buffers) with no
+// allocation in the hot loop; Python binds it via ctypes
+// (sagemaker_xgboost_container_tpu/data/native.py) with a pure-Python
+// fallback when no compiler is available.
+//
+// Accepted grammar per line (same as data/readers.py:parse_libsvm_text):
+//   <label>(:<weight>) (qid:<q>) (<idx>:<val>)*   [# comment]
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct Cursor {
+    const char* p;
+    const char* end;
+};
+
+inline void skip_spaces(Cursor& c) {
+    while (c.p < c.end && (*c.p == ' ' || *c.p == '\t' || *c.p == '\r')) ++c.p;
+}
+
+inline bool at_line_end(const Cursor& c) {
+    return c.p >= c.end || *c.p == '\n' || *c.p == '#';
+}
+
+inline void skip_line(Cursor& c) {
+    while (c.p < c.end && *c.p != '\n') ++c.p;
+    if (c.p < c.end) ++c.p;
+}
+
+// strtof/strtoll on a bounded, non-null-terminated buffer: the buffer handed
+// to us always ends with '\n' or we copy the tail, so direct strtof is safe
+// in practice; we bound-check via endptr anyway.
+inline bool parse_float(Cursor& c, float* out) {
+    char* endp = nullptr;
+    *out = strtof(c.p, &endp);
+    if (endp == c.p || endp > c.end) return false;
+    c.p = endp;
+    return true;
+}
+
+inline bool parse_int(Cursor& c, int64_t* out) {
+    char* endp = nullptr;
+    *out = strtoll(c.p, &endp, 10);
+    if (endp == c.p || endp > c.end) return false;
+    c.p = endp;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct LibsvmInfo {
+    int64_t n_rows;
+    int64_t nnz;
+    int64_t max_index;
+    int32_t has_weights;
+    int32_t has_qids;
+    int64_t error_line;  // 1-based line of first parse error, 0 if ok
+};
+
+// Pass 1: validate + count rows / non-zeros.
+int libsvm_count(const char* buf, int64_t len, LibsvmInfo* info) {
+    Cursor c{buf, buf + len};
+    info->n_rows = 0;
+    info->nnz = 0;
+    info->max_index = -1;
+    info->has_weights = 0;
+    info->has_qids = 0;
+    info->error_line = 0;
+    int64_t line_no = 0;
+    while (c.p < c.end) {
+        ++line_no;
+        skip_spaces(c);
+        if (at_line_end(c)) { skip_line(c); continue; }
+        float label;
+        if (!parse_float(c, &label)) { info->error_line = line_no; return 1; }
+        if (c.p < c.end && *c.p == ':') {
+            ++c.p;
+            float w;
+            if (!parse_float(c, &w)) { info->error_line = line_no; return 1; }
+            info->has_weights = 1;
+        }
+        while (true) {
+            skip_spaces(c);
+            if (at_line_end(c)) break;
+            if (c.end - c.p >= 4 && memcmp(c.p, "qid:", 4) == 0) {
+                c.p += 4;
+                int64_t q;
+                if (!parse_int(c, &q)) { info->error_line = line_no; return 1; }
+                info->has_qids = 1;
+                continue;
+            }
+            int64_t idx;
+            if (!parse_int(c, &idx) || c.p >= c.end || *c.p != ':') {
+                info->error_line = line_no;
+                return 1;
+            }
+            ++c.p;
+            float v;
+            if (!parse_float(c, &v)) { info->error_line = line_no; return 1; }
+            if (idx > info->max_index) info->max_index = idx;
+            ++info->nnz;
+        }
+        ++info->n_rows;
+        skip_line(c);
+    }
+    return 0;
+}
+
+// Pass 2: fill preallocated buffers (sizes from pass 1).
+int libsvm_fill(const char* buf, int64_t len, float* labels, float* weights,
+                int64_t* qids, int64_t* indices, float* values, int64_t* indptr) {
+    Cursor c{buf, buf + len};
+    int64_t row = 0;
+    int64_t k = 0;
+    indptr[0] = 0;
+    while (c.p < c.end) {
+        skip_spaces(c);
+        if (at_line_end(c)) { skip_line(c); continue; }
+        float label;
+        if (!parse_float(c, &label)) return 1;
+        labels[row] = label;
+        weights[row] = 1.0f;
+        if (qids) qids[row] = 0;
+        if (c.p < c.end && *c.p == ':') {
+            ++c.p;
+            float w;
+            if (!parse_float(c, &w)) return 1;
+            weights[row] = w;
+        }
+        while (true) {
+            skip_spaces(c);
+            if (at_line_end(c)) break;
+            if (c.end - c.p >= 4 && memcmp(c.p, "qid:", 4) == 0) {
+                c.p += 4;
+                int64_t q;
+                if (!parse_int(c, &q)) return 1;
+                if (qids) qids[row] = q;
+                continue;
+            }
+            int64_t idx;
+            if (!parse_int(c, &idx) || *c.p != ':') return 1;
+            ++c.p;
+            float v;
+            if (!parse_float(c, &v)) return 1;
+            indices[k] = idx;
+            values[k] = v;
+            ++k;
+        }
+        ++row;
+        indptr[row] = k;
+        skip_line(c);
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+"""ctypes bindings for the native data plane (native/fastdata.cpp).
+
+The shared library is compiled lazily on first use (g++ -O3, cached under the
+package build dir) — no pybind11 in the image, so the interface is a plain C
+ABI driven from ctypes with preallocated numpy buffers (two-pass: count, then
+fill). ``parse_libsvm_native`` returns the same tuple as the pure-Python
+tokenizer in readers.py and is None-able: callers fall back to Python when no
+compiler is available.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "fastdata.cpp",
+)
+_CACHE_DIR = os.path.join(tempfile.gettempdir(), "sm_xgb_tpu_native")
+_LIB_PATH = os.path.join(_CACHE_DIR, "libfastdata.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+class _LibsvmInfo(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("nnz", ctypes.c_int64),
+        ("max_index", ctypes.c_int64),
+        ("has_weights", ctypes.c_int32),
+        ("has_qids", ctypes.c_int32),
+        ("error_line", ctypes.c_int64),
+    ]
+
+
+def _build():
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SOURCE]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SOURCE)
+                and os.path.getmtime(_SOURCE) > os.path.getmtime(_LIB_PATH)
+            ):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.libsvm_count.restype = ctypes.c_int
+            lib.libsvm_count.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.POINTER(_LibsvmInfo),
+            ]
+            lib.libsvm_fill.restype = ctypes.c_int
+            lib.libsvm_fill.argtypes = [ctypes.c_char_p, ctypes.c_int64] + [
+                ctypes.c_void_p
+            ] * 6
+            _lib = lib
+        except Exception as e:  # no compiler / load failure -> python fallback
+            logger.info("native libsvm parser unavailable (%s); using python parser", e)
+            _lib = None
+    return _lib
+
+
+def native_available():
+    return _load() is not None
+
+
+def parse_libsvm_native(data):
+    """bytes -> (csr pieces, labels, weights|None, qids|None) or None.
+
+    Returns None when the native library is unavailable; raises ValueError on
+    malformed input (with the failing line number, matching the python
+    parser's UserError contract at the caller).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    info = _LibsvmInfo()
+    rc = lib.libsvm_count(data, len(data), ctypes.byref(info))
+    if rc != 0:
+        raise ValueError("Malformed LIBSVM line {}".format(info.error_line))
+    n, nnz = info.n_rows, info.nnz
+    labels = np.empty(n, np.float32)
+    weights = np.empty(n, np.float32)
+    qids = np.empty(n, np.int64) if info.has_qids else None
+    indices = np.empty(nnz, np.int64)
+    values = np.empty(nnz, np.float32)
+    indptr = np.empty(n + 1, np.int64)
+    rc = lib.libsvm_fill(
+        data,
+        len(data),
+        labels.ctypes.data_as(ctypes.c_void_p),
+        weights.ctypes.data_as(ctypes.c_void_p),
+        qids.ctypes.data_as(ctypes.c_void_p) if qids is not None else None,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        values.ctypes.data_as(ctypes.c_void_p),
+        indptr.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError("Malformed LIBSVM input")
+    return (
+        (values, indices, indptr),
+        labels,
+        weights if info.has_weights else None,
+        qids,
+    )

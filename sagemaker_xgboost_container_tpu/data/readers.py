@@ -108,12 +108,30 @@ def stage_input_files(data_path, staging_dir=STAGING_DIR):
     return staging_dir if found_any else None
 
 
+_SIDECAR_SUFFIXES = (".group", ".weight")
+
+
 def _list_data_files(path):
     if os.path.isfile(path):
         return [path]
-    return sorted(
+    files = sorted(
         os.path.join(path, f) for f in os.listdir(path) if _is_data_file(path, f)
     )
+    # sidecar group/weight files ride along with their data file; don't parse
+    # them as data (staged links carry a hash suffix, so match on the target)
+    out = []
+    for f in files:
+        real = os.path.realpath(f)
+        if any(real.endswith(s) for s in _SIDECAR_SUFFIXES):
+            base = real
+            for s in _SIDECAR_SUFFIXES:
+                if base.endswith(s):
+                    base = base[: -len(s)]
+                    break
+            if any(os.path.realpath(g) == base for g in files if g != f):
+                continue
+        out.append(f)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -245,7 +263,24 @@ def parse_libsvm_text(text, num_col=None):
 
     Accepts ``<label>(:<weight>) (qid:<q>) <idx>:<val> ...``. Indices are
     taken verbatim as 0-based column ids, matching xgboost's file parser.
+    Uses the native C++ tokenizer (data/native.py) when available; the
+    pure-Python path below is the fallback and the behavioral spec.
     """
+    from .native import parse_libsvm_native
+
+    try:
+        parsed = parse_libsvm_native(text)
+    except ValueError as e:
+        raise exc.UserError(str(e), caused_by=e)
+    if parsed is not None:
+        (values, indices, indptr), labels_arr, weights_arr, qids_arr = parsed
+        n = len(labels_arr)
+        if n == 0:
+            return None
+        width = num_col or (int(indices.max()) + 1 if len(indices) else 1)
+        csr = sp.csr_matrix((values, indices, indptr), shape=(n, width))
+        return csr, labels_arr, weights_arr, qids_arr
+
     labels, weights, qids = [], [], []
     data, indices, indptr = [], [], [0]
     has_weights = has_qids = False
@@ -304,16 +339,38 @@ def _qids_to_groups(qids):
     return np.diff(bounds).astype(np.int32)
 
 
+def _companion_file(data_file, suffixes):
+    """xgboost-style sidecar files (train.libsvm.group / .weight(s))."""
+    for suffix in suffixes:
+        p = data_file + suffix
+        # staged symlinks carry a hash suffix; check the link target's siblings
+        target = os.path.realpath(data_file)
+        tp = target + suffix
+        if os.path.exists(p):
+            return p
+        if os.path.exists(tp):
+            return tp
+    return None
+
+
 def _read_libsvm_files(path):
     files = _list_data_files(path)
     if not files:
         return None
     parts = []
+    sidecar_groups = []
+    sidecar_weights = []
     for f in files:
         with open(f, "r", errors="ignore") as fh:
             parsed = parse_libsvm_text(fh.read())
         if parsed is not None:
             parts.append(parsed)
+            gf = _companion_file(f, (".group",))
+            if gf:
+                sidecar_groups.append(np.loadtxt(gf, dtype=np.int64).reshape(-1))
+            wf = _companion_file(f, (".weight",))
+            if wf:
+                sidecar_weights.append(np.loadtxt(wf, dtype=np.float32).reshape(-1))
     if not parts:
         return None
     width = max(p[0].shape[1] for p in parts)
@@ -329,7 +386,12 @@ def _read_libsvm_files(path):
     qids = (
         np.concatenate([p[3] for p in parts]) if all(p[3] is not None for p in parts) else None
     )
-    return DataMatrix(csr, labels=labels, weights=weights, groups=_qids_to_groups(qids))
+    groups = _qids_to_groups(qids)
+    if sidecar_groups and len(sidecar_groups) == len(parts):
+        groups = np.concatenate(sidecar_groups).astype(np.int32)
+    if weights is None and sidecar_weights and len(sidecar_weights) == len(parts):
+        weights = np.concatenate(sidecar_weights)
+    return DataMatrix(csr, labels=labels, weights=weights, groups=groups)
 
 
 def _read_parquet_files(path):

@@ -97,6 +97,12 @@ class TrainConfig:
                 "num_parallel_tree > 1 combined with multi-class objectives is not "
                 "supported yet."
             )
+        if p.get("process_type") == "update":
+            raise exc.UserError(
+                "process_type='update' (refresh/prune of an existing model) is not "
+                "supported yet in the TPU container; retrain with process_type="
+                "'default' instead."
+            )
 
 
 def _eval_metric_names(config, objective):

@@ -213,6 +213,8 @@ def train_job(
         if val_dmatrix is not None:
             watchlist.append((val_dmatrix, "validation"))
 
+        from .profiling import xla_trace
+
         if kfold is None:
             xgb_model, iteration, callbacks = get_callbacks(
                 model_dir=model_dir,
@@ -224,15 +226,16 @@ def train_job(
                 is_master=is_master,
                 num_round=num_round,
             )
-            bst = booster.train(
-                train_cfg,
-                train_dmatrix,
-                num_boost_round=num_round - iteration,
-                evals=watchlist,
-                feval=configured_feval,
-                callbacks=callbacks,
-                xgb_model=xgb_model,
-            )
+            with xla_trace():
+                bst = booster.train(
+                    train_cfg,
+                    train_dmatrix,
+                    num_boost_round=num_round - iteration,
+                    evals=watchlist,
+                    feval=configured_feval,
+                    callbacks=callbacks,
+                    xgb_model=xgb_model,
+                )
         else:
             num_cv_round = train_cfg.pop("_num_cv_round", 1)
             logger.info(

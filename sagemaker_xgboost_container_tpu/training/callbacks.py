@@ -124,6 +124,11 @@ def get_callbacks(
 
     callbacks = [EvaluationMonitor()]
 
+    if os.environ.get("SM_ROUND_TIMING", "").lower() in ("1", "true"):
+        from .profiling import RoundTimer
+
+        callbacks.append(RoundTimer())
+
     if checkpoint_dir and is_master:
         callbacks.append(
             checkpointing.SaveCheckpointCallBack(

@@ -1,0 +1,75 @@
+"""First-class training profiling (SURVEY.md §5: the reference's only tracing
+was wall-clock tracker logs; smdebug was installed but disabled).
+
+Two light-weight hooks:
+
+* ``RoundTimer`` — per-round wall time + throughput, logged every
+  ``log_every`` rounds and summarized at end of training.
+* ``xla_trace`` — context manager around training that writes a JAX profiler
+  trace (TensorBoard-viewable) when ``SM_PROFILER_TRACE_DIR`` is set.
+"""
+
+import contextlib
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+TRACE_DIR_ENV = "SM_PROFILER_TRACE_DIR"
+
+
+class RoundTimer:
+    def __init__(self, num_rows=None, log_every=10):
+        self.num_rows = num_rows
+        self.log_every = log_every
+        self._last = None
+        self._times = []
+
+    def before_training(self, model):
+        self._last = time.perf_counter()
+        return model
+
+    def after_iteration(self, model, epoch, evals_log):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+            if self.log_every and (epoch + 1) % self.log_every == 0:
+                recent = self._times[-self.log_every :]
+                mean = sum(recent) / len(recent)
+                msg = "round {}: {:.1f} ms/round".format(epoch, mean * 1000)
+                if self.num_rows:
+                    msg += " ({:.2f}M rows/sec)".format(
+                        self.num_rows / mean / 1e6
+                    )
+                logger.info(msg)
+        self._last = now
+        return False
+
+    def after_training(self, model):
+        if self._times:
+            total = sum(self._times)
+            logger.info(
+                "trained %d rounds in %.2fs (%.2f rounds/sec)",
+                len(self._times),
+                total,
+                len(self._times) / total,
+            )
+        return model
+
+
+@contextlib.contextmanager
+def xla_trace():
+    """Capture a JAX profiler trace when SM_PROFILER_TRACE_DIR is set."""
+    trace_dir = os.environ.get(TRACE_DIR_ENV)
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("Wrote XLA profiler trace to %s", trace_dir)

@@ -1,0 +1,88 @@
+"""Native C++ libsvm tokenizer: equivalence with the pure-Python parser."""
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data import native
+from sagemaker_xgboost_container_tpu.data.readers import parse_libsvm_text
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="no C++ toolchain"
+)
+
+SAMPLE = """\
+1 2:1 5:0.5
+0 0:3.5 2:-1
+2.5:0.25 1:7
+# a comment line
+-1 qid:3 4:1e-3
+"""
+
+
+def _python_parse(text, num_col=None):
+    from sagemaker_xgboost_container_tpu.data import readers
+
+    native._lib = None
+    native._tried = True  # force fallback
+    try:
+        return readers.parse_libsvm_text(text, num_col)
+    finally:
+        native._tried = False
+
+
+def test_equivalence_on_sample():
+    native._tried = False
+    got = parse_libsvm_text(SAMPLE)
+    want = _python_parse(SAMPLE)
+    native._tried = False
+    assert got[0].shape == want[0].shape
+    np.testing.assert_allclose(got[0].toarray(), want[0].toarray())
+    np.testing.assert_allclose(got[1], want[1])  # labels
+    np.testing.assert_allclose(got[2], want[2])  # weights (one line has one)
+
+
+def test_equivalence_on_abalone():
+    with open("/root/reference/test/resources/abalone/data/train/abalone.train_0") as f:
+        text = f.read()
+    native._tried = False
+    got = parse_libsvm_text(text)
+    want = _python_parse(text)
+    native._tried = False
+    np.testing.assert_allclose(got[0].toarray(), want[0].toarray())
+    np.testing.assert_allclose(got[1], want[1])
+
+
+def test_malformed_raises_usererror():
+    native._tried = False
+    with pytest.raises(exc.UserError):
+        parse_libsvm_text("1 2:abc\n")
+    with pytest.raises(exc.UserError):
+        parse_libsvm_text("1 nocolon\n")
+
+
+def test_throughput_not_slower_than_python():
+    import time
+
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(20000):
+        idx = np.sort(rng.choice(50, size=10, replace=False))
+        lines.append(
+            "{:.3f} ".format(rng.randn())
+            + " ".join("{}:{:.4f}".format(i, rng.randn()) for i in idx)
+        )
+    text = "\n".join(lines)
+
+    native._tried = False
+    t0 = time.perf_counter()
+    parse_libsvm_text(text)
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    _python_parse(text)
+    t_python = time.perf_counter() - t0
+    native._tried = False
+    # the native path should be dramatically faster; assert a loose bound so
+    # CI noise can't flake it
+    assert t_native < t_python, (t_native, t_python)

@@ -59,11 +59,19 @@ class TrainConfig:
         self.eta = float(p.get("eta", 0.3))
         max_depth = p.get("max_depth", 6)
         self.max_depth = int(max_depth) if max_depth is not None else 6
-        if self.max_depth == 0:
+        self.grow_policy = p.get("grow_policy", "depthwise")
+        self.max_leaves = int(p.get("max_leaves", 0) or 0)
+        if self.grow_policy == "lossguide" and self.max_leaves <= 0:
+            # xgboost's 0 means unlimited; static shapes need a bound
+            raise exc.UserError(
+                "grow_policy='lossguide' requires max_leaves >= 2 in the TPU "
+                "container (static-shape tree builder)."
+            )
+        if self.max_depth == 0 and self.grow_policy != "lossguide":
             raise exc.UserError(
                 "max_depth=0 (unlimited depth) is not supported by the TPU static-shape "
-                "tree builder; set max_depth >= 1 (or use grow_policy=lossguide with "
-                "max_leaves in a future release)."
+                "tree builder with grow_policy='depthwise'; set max_depth >= 1 or use "
+                "grow_policy='lossguide' with max_leaves."
             )
         self.reg_lambda = float(p.get("lambda", 1.0))
         self.alpha = float(p.get("alpha", 0.0))
@@ -80,6 +88,7 @@ class TrainConfig:
         self.base_score = float(p.get("base_score", 0.5))
         self.tree_method = p.get("tree_method", "auto")
         self.monotone_constraints = p.get("monotone_constraints")
+        self.interaction_constraints = p.get("interaction_constraints")
         self.eval_metric = p.get("eval_metric")
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1) or 1)
         self.booster = p.get("booster", "gbtree")
@@ -92,10 +101,20 @@ class TrainConfig:
             raise exc.UserError(
                 "tree_method 'gpu_hist' is not available in the TPU container; use 'hist'."
             )
+        self.predict_depth = (
+            (self.max_depth if self.max_depth > 0 else self.max_leaves - 1)
+            if self.grow_policy == "lossguide"
+            else self.max_depth
+        )
         if self.num_parallel_tree > 1 and self.num_class > 1:
             raise exc.UserError(
                 "num_parallel_tree > 1 combined with multi-class objectives is not "
                 "supported yet."
+            )
+        if self.grow_policy == "lossguide" and self.colsample_bylevel < 1.0:
+            raise exc.UserError(
+                "colsample_bylevel is not supported with grow_policy='lossguide' yet; "
+                "use colsample_bytree."
             )
         if p.get("process_type") == "update":
             raise exc.UserError(
@@ -248,13 +267,21 @@ class _TrainingSession:
         cfg = self.config
         num_bins = self.train_binned.num_bins
         axis_name = "data" if self.mesh is not None else None
+        interaction_sets = None
+        if cfg.interaction_constraints:
+            d_cols = self.train_binned.num_col
+            sets_np = np.zeros((len(cfg.interaction_constraints), d_cols), bool)
+            for s, members in enumerate(cfg.interaction_constraints):
+                for f in members:
+                    if 0 <= int(f) < d_cols:
+                        sets_np[s, int(f)] = True
+            interaction_sets = jnp.asarray(sets_np)
+
         # With num_parallel_tree=K, all K trees of a round fit the *same*
         # gradients (a bagged forest step), so their summed corrections are
         # averaged via eta/K — otherwise the round overshoots by K.
         effective_eta = cfg.eta / cfg.num_parallel_tree
-        builder = partial(
-            build_tree,
-            max_depth=cfg.max_depth,
+        common = dict(
             num_bins=num_bins,
             reg_lambda=cfg.reg_lambda,
             alpha=cfg.alpha,
@@ -264,7 +291,19 @@ class _TrainingSession:
             max_delta_step=cfg.max_delta_step,
             colsample_bylevel=cfg.colsample_bylevel,
             axis_name=axis_name,
+            interaction_sets=interaction_sets,
         )
+        if cfg.grow_policy == "lossguide":
+            from ..ops.lossguide import build_tree_lossguide
+
+            builder = partial(
+                build_tree_lossguide,
+                max_leaves=cfg.max_leaves,
+                max_depth=cfg.max_depth,
+                **common,
+            )
+        else:
+            builder = partial(build_tree, max_depth=cfg.max_depth, **common)
         ranking_grads = self._grad_hess_fn()
         grad_hess = self.objective.grad_hess
         num_group = self.num_group
@@ -383,13 +422,13 @@ class _TrainingSession:
             if num_group == 1:
                 if num_parallel > 1:
                     delta = jax.vmap(
-                        lambda t: predict_binned(t, bins, cfg.max_depth, num_bins)
+                        lambda t: predict_binned(t, bins, cfg.predict_depth, num_bins)
                     )(tree).sum(axis=0)
                 else:
-                    delta = predict_binned(tree, bins, cfg.max_depth, num_bins)
+                    delta = predict_binned(tree, bins, cfg.predict_depth, num_bins)
                 return margins + delta
             deltas = jax.vmap(
-                lambda t: predict_binned(t, bins, cfg.max_depth, num_bins)
+                lambda t: predict_binned(t, bins, cfg.predict_depth, num_bins)
             )(tree)
             return margins + deltas.T
 

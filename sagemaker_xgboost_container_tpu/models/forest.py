@@ -75,10 +75,10 @@ def _parents_from_children(left, right):
 
 
 def compact_padded_tree(padded, cut_points):
-    """Trainer's padded full-binary arrays (numpy) -> compact Tree.
+    """Trainer's padded arrays (numpy) -> compact Tree.
 
-    Keeps only reachable nodes (BFS from root through split nodes); split bin
-    indices become float thresholds via the feature's cut array.
+    Keeps only reachable nodes (BFS from root through explicit child indices);
+    split bin indices become float thresholds via the feature's cut array.
     """
     is_leaf = np.asarray(padded["is_leaf"])
     feature = np.asarray(padded["feature"])
@@ -88,13 +88,19 @@ def compact_padded_tree(padded, cut_points):
     base_weight = np.asarray(padded["base_weight"])
     gain = np.asarray(padded["gain"])
     sum_hess = np.asarray(padded["sum_hess"])
+    if "left" in padded:
+        child_left = np.asarray(padded["left"])
+        child_right = np.asarray(padded["right"])
+    else:  # legacy full-binary layout
+        ids = np.arange(len(is_leaf), dtype=np.int32)
+        child_left, child_right = 2 * ids + 1, 2 * ids + 2
 
     # BFS in padded numbering, assigning compact ids in visit order
     order = [0]
     compact_id = {0: 0}
     for node in order:
         if not is_leaf[node]:
-            for child in (2 * node + 1, 2 * node + 2):
+            for child in (int(child_left[node]), int(child_right[node])):
                 compact_id[child] = len(order)
                 order.append(child)
 
@@ -121,8 +127,8 @@ def compact_padded_tree(padded, cut_points):
             out["feature"][cid] = f
             out["threshold"][cid] = cut_points[f][int(bin_idx[node])]
             out["default_left"][cid] = default_left[node]
-            out["left"][cid] = compact_id[2 * node + 1]
-            out["right"][cid] = compact_id[2 * node + 2]
+            out["left"][cid] = compact_id[int(child_left[node])]
+            out["right"][cid] = compact_id[int(child_right[node])]
             out["gain"][cid] = gain[node]
     return Tree(**out)
 

@@ -1,0 +1,198 @@
+"""Leaf-wise (lossguide) tree growth: best-gain-first splitting to max_leaves.
+
+The reference validates grow_policy=lossguide + max_leaves
+(hyperparameter_validation.py:259-260) and delegates to libxgboost's
+lossguide updater (LightGBM-style growth). Static-shape XLA formulation:
+
+* node slots are allocated sequentially (root=0; split t creates 2t+1, 2t+2),
+  explicit child indices — the shared tree layout of ops/tree_build;
+* ``max_leaves - 1`` split steps are unrolled; each step picks the global
+  best-gain leaf (argmax over the candidate store), routes its rows, and
+  histograms only the two fresh children (W=2 level histogram);
+* every leaf keeps a precomputed best-split candidate, so step selection is
+  O(nodes), not O(n).
+
+Cost note: each step rescans all n rows for the 2-child histogram, so a tree
+costs O(max_leaves * n * d) versus depthwise's O(max_depth * n * d); this is
+inherent to static-shape leaf-wise growth without dynamic row partitions.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import level_histogram
+from .split import find_best_splits, leaf_weight
+
+MIN_SPLIT_LOSS = 1e-6
+
+
+def build_tree_lossguide(
+    bins,
+    grad,
+    hess,
+    num_cuts,
+    max_leaves,
+    num_bins,
+    max_depth=0,
+    reg_lambda=1.0,
+    alpha=0.0,
+    gamma=0.0,
+    min_child_weight=1.0,
+    eta=0.3,
+    max_delta_step=0.0,
+    feature_mask=None,
+    monotone=None,
+    axis_name=None,
+    rng=None,
+    colsample_bylevel=1.0,
+    interaction_sets=None,
+):
+    """Grow one leaf-wise tree. Returns (tree arrays dict, row_out [n]).
+
+    Same output layout as ops.tree_build.build_tree; max_depth=0 means
+    unbounded depth (bounded by max_leaves - 1).
+    """
+    if interaction_sets is not None:
+        raise NotImplementedError(
+            "interaction_constraints with grow_policy=lossguide is not supported yet"
+        )
+    n, d = bins.shape
+    bins = bins.astype(jnp.int32)
+    max_nodes = 2 * max_leaves - 1
+    depth_cap = max_depth if max_depth > 0 else max_leaves
+
+    tree = {
+        "feature": jnp.zeros(max_nodes, jnp.int32),
+        "bin": jnp.zeros(max_nodes, jnp.int32),
+        "default_left": jnp.zeros(max_nodes, jnp.bool_),
+        "is_leaf": jnp.ones(max_nodes, jnp.bool_),
+        "leaf_value": jnp.zeros(max_nodes, jnp.float32),
+        "base_weight": jnp.zeros(max_nodes, jnp.float32),
+        "gain": jnp.zeros(max_nodes, jnp.float32),
+        "sum_hess": jnp.zeros(max_nodes, jnp.float32),
+        "left": jnp.arange(max_nodes, dtype=jnp.int32),
+        "right": jnp.arange(max_nodes, dtype=jnp.int32),
+    }
+    # per-leaf best-split candidate store
+    cand = {
+        "gain": jnp.full(max_nodes, -jnp.inf, jnp.float32),
+        "feature": jnp.zeros(max_nodes, jnp.int32),
+        "bin": jnp.zeros(max_nodes, jnp.int32),
+        "default_left": jnp.zeros(max_nodes, jnp.bool_),
+    }
+    node_g = jnp.zeros(max_nodes, jnp.float32)
+    node_h = jnp.zeros(max_nodes, jnp.float32)
+    node_depth = jnp.zeros(max_nodes, jnp.int32)
+
+    node_of_row = jnp.zeros(n, jnp.int32)
+
+    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab):
+        """Histogram the two fresh children and return their candidates.
+
+        parent_rows_mask_nodes: node_local [n] mapping rows to {0,1,-1}.
+        """
+        G, H = level_histogram(
+            bins, grad, hess, parent_rows_mask_nodes, 2, num_bins, axis_name=axis_name
+        )
+        splits = find_best_splits(
+            G,
+            H,
+            num_cuts,
+            reg_lambda=reg_lambda,
+            alpha=alpha,
+            gamma=gamma,
+            min_child_weight=min_child_weight,
+            feature_mask=feature_mask,
+            monotone=monotone,
+        )
+        # depth cap: children at depth_cap can never split
+        can_deepen = depth_ab < depth_cap
+        gains = jnp.where(can_deepen, splits["gain"], -jnp.inf)
+        return splits, gains
+
+    # root candidate
+    root_local = jnp.zeros(n, jnp.int32)
+    G, H = level_histogram(bins, grad, hess, root_local, 1, num_bins, axis_name=axis_name)
+    root_splits = find_best_splits(
+        G, H, num_cuts,
+        reg_lambda=reg_lambda, alpha=alpha, gamma=gamma,
+        min_child_weight=min_child_weight, feature_mask=feature_mask, monotone=monotone,
+    )
+    cand["gain"] = cand["gain"].at[0].set(root_splits["gain"][0])
+    cand["feature"] = cand["feature"].at[0].set(root_splits["feature"][0])
+    cand["bin"] = cand["bin"].at[0].set(root_splits["bin"][0])
+    cand["default_left"] = cand["default_left"].at[0].set(root_splits["default_left"][0])
+    node_g = node_g.at[0].set(root_splits["g_total"][0])
+    node_h = node_h.at[0].set(root_splits["h_total"][0])
+
+    for t in range(max_leaves - 1):
+        id_a, id_b = 2 * t + 1, 2 * t + 2
+        leaf_mask = tree["is_leaf"]
+        gains = jnp.where(leaf_mask, cand["gain"], -jnp.inf)
+        l = jnp.argmax(gains).astype(jnp.int32)
+        can = gains[l] > MIN_SPLIT_LOSS
+
+        f_l = cand["feature"][l]
+        b_l = cand["bin"][l]
+        dl_l = cand["default_left"][l]
+
+        # mark split
+        tree["feature"] = tree["feature"].at[l].set(jnp.where(can, f_l, tree["feature"][l]))
+        tree["bin"] = tree["bin"].at[l].set(jnp.where(can, b_l, tree["bin"][l]))
+        tree["default_left"] = tree["default_left"].at[l].set(
+            jnp.where(can, dl_l, tree["default_left"][l])
+        )
+        tree["is_leaf"] = tree["is_leaf"].at[l].set(
+            jnp.where(can, False, tree["is_leaf"][l])
+        )
+        tree["gain"] = tree["gain"].at[l].set(jnp.where(can, gains[l], tree["gain"][l]))
+        tree["left"] = tree["left"].at[l].set(jnp.where(can, id_a, tree["left"][l]))
+        tree["right"] = tree["right"].at[l].set(jnp.where(can, id_b, tree["right"][l]))
+        # exhausted leaves can't be re-picked
+        cand["gain"] = cand["gain"].at[l].set(-jnp.inf)
+
+        # route rows of l
+        in_l = node_of_row == l
+        row_bin = jnp.take_along_axis(bins, f_l[None].repeat(n)[:, None], axis=1)[:, 0]
+        is_missing = row_bin == (num_bins - 1)
+        go_right = jnp.where(is_missing, ~dl_l, row_bin > b_l)
+        new_node = jnp.where(go_right, id_b, id_a)
+        node_of_row = jnp.where(in_l & can, new_node, node_of_row)
+
+        # children depth + candidates
+        depth_ab = node_depth[l] + 1
+        node_depth = node_depth.at[id_a].set(depth_ab)
+        node_depth = node_depth.at[id_b].set(depth_ab)
+        child_local = jnp.where(
+            can & (node_of_row == id_a),
+            0,
+            jnp.where(can & (node_of_row == id_b), 1, -1),
+        )
+        splits, child_gains = _score_children(
+            child_local, id_a, id_b, jnp.stack([depth_ab, depth_ab])
+        )
+        valid = can
+        cand["gain"] = cand["gain"].at[id_a].set(jnp.where(valid, child_gains[0], -jnp.inf))
+        cand["gain"] = cand["gain"].at[id_b].set(jnp.where(valid, child_gains[1], -jnp.inf))
+        cand["feature"] = cand["feature"].at[id_a].set(splits["feature"][0])
+        cand["feature"] = cand["feature"].at[id_b].set(splits["feature"][1])
+        cand["bin"] = cand["bin"].at[id_a].set(splits["bin"][0])
+        cand["bin"] = cand["bin"].at[id_b].set(splits["bin"][1])
+        cand["default_left"] = cand["default_left"].at[id_a].set(splits["default_left"][0])
+        cand["default_left"] = cand["default_left"].at[id_b].set(splits["default_left"][1])
+        node_g = node_g.at[id_a].set(splits["g_total"][0])
+        node_g = node_g.at[id_b].set(splits["g_total"][1])
+        node_h = node_h.at[id_a].set(splits["h_total"][0])
+        node_h = node_h.at[id_b].set(splits["h_total"][1])
+        # children of a non-split never get rows, so their -inf gains + zero
+        # totals are inert
+
+    # finalize leaf values for every (reachable) leaf slot
+    weight = leaf_weight(node_g, node_h, reg_lambda=reg_lambda, alpha=alpha,
+                         max_delta_step=max_delta_step)
+    tree["base_weight"] = weight
+    tree["sum_hess"] = node_h
+    tree["leaf_value"] = jnp.where(tree["is_leaf"], eta * weight, 0.0)
+
+    row_out = tree["leaf_value"][node_of_row]
+    return tree, row_out

@@ -45,7 +45,8 @@ def find_best_splits(
       G, H: f32 [W, d, B] level histograms (B includes the missing slot).
       num_cuts: i32 [d] — number of real cut thresholds per feature; splits
         are only legal at bin < num_cuts[f].
-      feature_mask: optional f32/bool [d] colsample mask (1 = usable).
+      feature_mask: optional f32/bool [d] colsample mask, or [W, d] per-node
+        mask (interaction constraints); 1 = usable.
       monotone: optional i32 [d] in {-1, 0, 1} monotone constraints.
 
     Returns dict of per-node arrays (length W): gain f32, feature i32,
@@ -92,7 +93,10 @@ def find_best_splits(
     legal = bin_ids < num_cuts[:, None]                    # [d, nbins]
     legal = legal[None, :, :]
     if feature_mask is not None:
-        legal = legal & (feature_mask[None, :, None] > 0)
+        if feature_mask.ndim == 2:  # [W, d] per-node mask
+            legal = legal & (feature_mask[:, :, None] > 0)
+        else:
+            legal = legal & (feature_mask[None, :, None] > 0)
     gain_right = jnp.where(legal, gain_right, -jnp.inf)
     gain_left = jnp.where(legal, gain_left, -jnp.inf)
 

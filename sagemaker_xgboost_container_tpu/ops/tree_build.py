@@ -46,6 +46,7 @@ def build_tree(
     axis_name=None,
     rng=None,
     colsample_bylevel=1.0,
+    interaction_sets=None,
 ):
     """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
 
@@ -72,6 +73,14 @@ def build_tree(
     node_of_row = jnp.zeros(n, jnp.int32)
     row_out = jnp.zeros(n, jnp.float32)
 
+    # interaction constraints: per-node alive constraint sets. A feature is
+    # usable in a node iff some still-alive set contains it; splitting on f
+    # keeps alive only the sets containing f (xgboost semantics).
+    alive_sets = None
+    if interaction_sets is not None:
+        num_sets = interaction_sets.shape[0]
+        alive_sets = jnp.ones((1, num_sets), jnp.bool_)
+
     for level in range(max_depth + 1):
         first = 2**level - 1
         width = 2**level
@@ -87,6 +96,13 @@ def build_tree(
             draw = jax.random.uniform(jax.random.fold_in(rng, level), (d,))
             sampled = (draw < colsample_bylevel).astype(jnp.float32)
             level_mask = sampled if level_mask is None else level_mask * sampled
+        if alive_sets is not None:
+            # [W, S] @ [S, d] -> per-node allowed-feature mask
+            node_allowed = (
+                alive_sets.astype(jnp.float32) @ interaction_sets.astype(jnp.float32)
+            ) > 0
+            per_node = node_allowed.astype(jnp.float32)
+            level_mask = per_node if level_mask is None else per_node * level_mask[None, :]
         splits = find_best_splits(
             G,
             H,
@@ -141,6 +157,16 @@ def build_tree(
             row_leafed, -1, jnp.where(at_level, child, node_of_row)
         )
 
+        if alive_sets is not None and level < max_depth:
+            feat_sets = interaction_sets[:, splits["feature"]].T  # [W, S]
+            child_alive = alive_sets & feat_sets
+            alive_sets = jnp.repeat(child_alive, 2, axis=0)       # [2W, S]
+
+    # explicit child indices (leaves self-loop), so depthwise and lossguide
+    # trees share one predict/compact layout
+    ids = jnp.arange(max_nodes, dtype=jnp.int32)
+    tree["left"] = jnp.where(tree["is_leaf"], ids, 2 * ids + 1)
+    tree["right"] = jnp.where(tree["is_leaf"], ids, 2 * ids + 2)
     return tree, row_out
 
 
@@ -153,6 +179,8 @@ _TREE_FIELDS = (
     "base_weight",
     "gain",
     "sum_hess",
+    "left",
+    "right",
 )
 
 
@@ -172,6 +200,8 @@ def tree_from_packed(packed):
         "base_weight": packed[5],
         "gain": packed[6],
         "sum_hess": packed[7],
+        "left": packed[8].astype(jnp.int32),
+        "right": packed[9].astype(jnp.int32),
     }
 
 
@@ -182,7 +212,7 @@ def unpack_tree(packed):
     out = {}
     for i, key in enumerate(_TREE_FIELDS):
         arr = np.asarray(packed[i])
-        if key in ("feature", "bin"):
+        if key in ("feature", "bin", "left", "right"):
             out[key] = arr.astype(np.int32)
         elif key in ("default_left", "is_leaf"):
             out[key] = arr.astype(bool)
@@ -192,10 +222,12 @@ def unpack_tree(packed):
 
 
 def predict_binned(tree, bins, max_depth, num_bins):
-    """Apply one trained (padded-layout) tree to binned rows -> margins.
+    """Apply one trained tree to binned rows -> margins.
 
-    Used for validation-set evaluation during training (validation is binned
-    with the training cuts, so bin comparison == float comparison).
+    Traverses explicit child indices (leaves self-loop) for ``max_depth``
+    steps — the max root->leaf distance for depthwise trees, max_leaves-1 for
+    lossguide. Used for validation-set evaluation during training (validation
+    is binned with the training cuts, so bin comparison == float comparison).
     """
     n = bins.shape[0]
     bins = bins.astype(jnp.int32)
@@ -206,6 +238,6 @@ def predict_binned(tree, bins, max_depth, num_bins):
         row_bin = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
         is_missing = row_bin == (num_bins - 1)
         go_right = jnp.where(is_missing, ~tree["default_left"][node], row_bin > split_bin)
-        child = node * 2 + 1 + go_right.astype(jnp.int32)
+        child = jnp.where(go_right, tree["right"][node], tree["left"][node])
         node = jnp.where(tree["is_leaf"][node], node, child)
     return tree["leaf_value"][node]

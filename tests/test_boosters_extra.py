@@ -149,3 +149,47 @@ def test_survival_cox():
     # higher predicted risk should correlate with the true hazard
     corr = np.corrcoef(margin, np.log(hazard))[0, 1]
     assert corr > 0.8, corr
+
+
+def test_lossguide_learns_and_respects_max_leaves():
+    X, y = _linear_data(1200, seed=9)
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {
+            "grow_policy": "lossguide",
+            "max_leaves": 16,
+            "max_depth": 0,
+            "eta": 0.3,
+        },
+        dtrain,
+        num_boost_round=15,
+    )
+    base = float(np.sqrt(np.mean((y - y.mean()) ** 2)))
+    rmse = eval_metric("rmse", forest.predict(X), y)
+    assert rmse < 0.35 * base, (rmse, base)
+    for t in forest.trees:
+        n_leaves = int((t.left < 0).sum())
+        assert n_leaves <= 16
+
+
+def test_lossguide_depth_cap():
+    X, y = _linear_data(800, seed=10)
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {"grow_policy": "lossguide", "max_leaves": 32, "max_depth": 3},
+        dtrain,
+        num_boost_round=5,
+    )
+    assert max(t.depth() for t in forest.trees) <= 3
+
+
+def test_lossguide_requires_max_leaves():
+    from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+    X, y = _linear_data(100)
+    with pytest.raises(exc.UserError, match="max_leaves"):
+        train(
+            {"grow_policy": "lossguide"},
+            DataMatrix(X, labels=y),
+            num_boost_round=1,
+        )

@@ -195,3 +195,39 @@ def test_cluster_synchronize_multiprocess():
     assert results["127.0.0.1"] == results["localhost"]
     flags = {m["host"]: m["include_in_training"] for m in results["localhost"]}
     assert flags == {"127.0.0.1": True, "localhost": False}
+
+
+def test_interaction_constraints_enforced():
+    rng = np.random.RandomState(11)
+    X = rng.rand(1500, 4).astype(np.float32)
+    # signal mixes features 0 and 2 multiplicatively; constraints forbid
+    # {0,1} x {2,3} interaction, so no path may use both 0 and 2
+    y = (X[:, 0] * X[:, 2] * 10).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {
+            "max_depth": 4,
+            "tree_method": "hist",
+            "interaction_constraints": [[0, 1], [2, 3]],
+        },
+        dtrain,
+        num_boost_round=8,
+    )
+
+    def paths_ok(tree):
+        # walk root->leaf collecting split features; each path must stay
+        # within one constraint set
+        sets = [{0, 1}, {2, 3}]
+        stack = [(0, frozenset())]
+        while stack:
+            node, used = stack.pop()
+            if tree.left[node] < 0:
+                if used and not any(used <= s for s in sets):
+                    return False
+                continue
+            used2 = used | {int(tree.feature[node])}
+            stack.append((int(tree.left[node]), used2))
+            stack.append((int(tree.right[node]), used2))
+        return True
+
+    assert all(paths_ok(t) for t in forest.trees)

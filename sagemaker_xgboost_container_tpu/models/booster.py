@@ -150,6 +150,15 @@ class _TrainingSession:
         self.num_group = self.objective.num_output_group
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+        # multi-host: every process holds its own row shard; device arrays are
+        # assembled into global arrays over the whole mesh
+        self.is_multiprocess = mesh is not None and jax.process_count() > 1
+        if self.is_multiprocess:
+            # local rows pad to a multiple of *local* devices; the global
+            # array is the concatenation over processes
+            self.pad_unit = max(1, len(mesh.local_devices))
+        else:
+            self.pad_unit = self.n_shards
 
         labels = dtrain.labels
         self.objective.validate_labels(labels)
@@ -177,7 +186,30 @@ class _TrainingSession:
         else:
             self.row_index = None
 
-        self.train_binned = bin_matrix(dtrain, config.max_bin)
+        shared_cuts = None
+        if self.is_multiprocess:
+            # every host must bin with identical thresholds or the psum'd
+            # histograms are meaningless; host 0's shard-local quantile cuts
+            # are broadcast to all (a sketch approximation of the global
+            # quantiles — a mergeable distributed sketch can replace this)
+            from jax.experimental import multihost_utils
+
+            from ..data.binning import compute_cut_points
+
+            local_cuts = compute_cut_points(
+                dtrain.features, dtrain.weights, config.max_bin
+            )
+            width = config.max_bin - 1
+            mat = np.full((dtrain.num_col, width), np.inf, np.float32)
+            counts = np.zeros(dtrain.num_col, np.int32)
+            for f, c in enumerate(local_cuts):
+                mat[f, : len(c)] = c
+                counts[f] = len(c)
+            mat = np.asarray(multihost_utils.broadcast_one_to_all(mat))
+            counts = np.asarray(multihost_utils.broadcast_one_to_all(counts))
+            shared_cuts = [mat[f, : counts[f]] for f in range(dtrain.num_col)]
+
+        self.train_binned = bin_matrix(dtrain, config.max_bin, cut_points=shared_cuts)
         self.cuts = self.train_binned.cut_points
         self.num_cuts = jnp.asarray(np.array([len(c) for c in self.cuts], np.int32))
         self.eval_sets = []
@@ -190,12 +222,22 @@ class _TrainingSession:
             self.eval_sets.append((name, dm, binned))
 
         self.n = dtrain.num_row
-        n_pad = -(-self.n // self.n_shards) * self.n_shards
+        n_pad = -(-self.n // self.pad_unit) * self.pad_unit
 
+        def _put(local_np, row_spec):
+            """Local host array -> device array (global across processes)."""
+            if not self.is_multiprocess:
+                return jnp.asarray(local_np)
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self.mesh, row_spec)
+            return jax.make_array_from_process_local_data(sharding, local_np)
+
+        margin_spec = P("data") if self.num_group == 1 else P("data", None)
         bins_np = _pad_rows(self.train_binned.bins, n_pad, self.train_binned.max_bin)
-        self.bins = jnp.asarray(bins_np)
-        self.labels = jnp.asarray(_pad_rows(labels, n_pad, 0.0))
-        self.weights = jnp.asarray(_pad_rows(dtrain.get_weight(), n_pad, 0.0))
+        self.bins = _put(bins_np, P("data", None))
+        self.labels = _put(_pad_rows(labels, n_pad, 0.0), P("data"))
+        self.weights = _put(_pad_rows(dtrain.get_weight(), n_pad, 0.0), P("data"))
         self.groups = dtrain.groups
 
         base = self.objective.base_margin(forest.base_score)
@@ -204,9 +246,11 @@ class _TrainingSession:
             margin = forest.predict_margin(dtrain.features).reshape(
                 (self.n,) if self.num_group == 1 else (self.n, self.num_group)
             )
-            self.margins = jnp.asarray(_pad_rows(margin, n_pad, base))
+            self.margins = _put(
+                _pad_rows(margin.astype(np.float32), n_pad, base), margin_spec
+            )
         else:
-            self.margins = jnp.full(shape, base, jnp.float32)
+            self.margins = _put(np.full(shape, base, np.float32), margin_spec)
 
         # eval-set device state: bins cached once, margins incremental
         self.eval_bins = []
@@ -216,18 +260,20 @@ class _TrainingSession:
                 self.eval_bins.append(None)     # shares training margins
                 self.eval_margins.append(None)
                 continue
-            m_pad = -(-dm.num_row // self.n_shards) * self.n_shards
+            m_pad = -(-dm.num_row // self.pad_unit) * self.pad_unit
             self.eval_bins.append(
-                jnp.asarray(_pad_rows(binned.bins, m_pad, binned.max_bin))
+                _put(_pad_rows(binned.bins, m_pad, binned.max_bin), P("data", None))
             )
             eshape = (m_pad,) if self.num_group == 1 else (m_pad, self.num_group)
             if forest.trees:
                 em = forest.predict_margin(dm.features).reshape(
                     (dm.num_row,) if self.num_group == 1 else (dm.num_row, self.num_group)
                 )
-                self.eval_margins.append(jnp.asarray(_pad_rows(em, m_pad, base)))
+                self.eval_margins.append(
+                    _put(_pad_rows(em.astype(np.float32), m_pad, base), margin_spec)
+                )
             else:
-                self.eval_margins.append(jnp.full(eshape, base, jnp.float32))
+                self.eval_margins.append(_put(np.full(eshape, base, np.float32), margin_spec))
 
         self.rng = jax.random.PRNGKey(config.seed)
 
@@ -476,12 +522,23 @@ class _TrainingSession:
         return [unpack_tree(packed_np[j]) for j in range(packed_np.shape[0])]
 
     # ----------------------------------------------------------------- eval
+    def _to_host(self, arr, n_real):
+        """Device margins -> host numpy. In multi-process mode this returns
+        the *local* shard's rows (each host evaluates its own data slice;
+        metric lines are per-host, matching how each host loaded only its own
+        channel shard)."""
+        if self.is_multiprocess:
+            shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+            local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+            return local[:n_real]
+        return np.asarray(arr)[:n_real]
+
     def margins_for(self, index):
         dm = self.eval_sets[index][1]
         m = self.eval_margins[index]
         if m is None:
-            return np.asarray(self.margins)[: self.n]
-        return np.asarray(m)[: dm.num_row]
+            return self._to_host(self.margins, self.n)
+        return self._to_host(m, dm.num_row)
 
     def evaluate(self, metric_names, feval=None):
         """Returns list of (data_name, metric_name, value) per eval set."""

@@ -230,18 +230,26 @@ class Forest:
                 )
             )
         stacked = self._stack(slice(tree_lo, tree_hi))
+        n = features.shape[0]
         if stacked is None:
-            n = features.shape[0]
             if self.num_output_group == 1:
                 return np.full(n, base, np.float32)
             return np.full((n, self.num_output_group), base, np.float32)
-        return forest_predict_margin(
+        # bucket the row count to a power of two so serving payloads of
+        # varying size share jit-compiled kernels instead of recompiling
+        n_pad = max(8, 1 << (int(n - 1).bit_length())) if n else 8
+        if n_pad != n:
+            features = np.concatenate(
+                [features, np.zeros((n_pad - n, features.shape[1]), np.float32)], axis=0
+            )
+        out = forest_predict_margin(
             stacked,
             features,
             num_output_group=self.num_output_group,
             base_margin=base,
             tree_info=self.tree_info[tree_lo:tree_hi],
         )
+        return out[:n]
 
     def predict(self, features, output_margin=False, iteration_range=None):
         margin = self.predict_margin(features, iteration_range=iteration_range)

@@ -41,11 +41,16 @@ def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name
     num_segments = num_nodes * d * num_bins + 1
 
     flat_seg = seg.reshape(-1)
-    # one fused pass: (g, h) pairs share the sort/scatter
-    gh = jnp.stack([grad, hess], axis=1)                      # [n, 2]
-    gh_flat = jnp.broadcast_to(gh[:, None, :], (n, d, 2)).reshape(-1, 2)
-    GH = jax.ops.segment_sum(gh_flat, flat_seg, num_segments=num_segments)
-    GH = GH[:-1].reshape(num_nodes, d, num_bins, 2)
+    # two 1-D passes: the fused [n*d, 2] segment_sum variant compiles
+    # pathologically on the TPU toolchain (multi-minute hang), so G and H go
+    # through separate scatter-adds
+    g_flat = jnp.broadcast_to(grad[:, None], (n, d)).reshape(-1)
+    h_flat = jnp.broadcast_to(hess[:, None], (n, d)).reshape(-1)
+    G = jax.ops.segment_sum(g_flat, flat_seg, num_segments=num_segments)
+    H = jax.ops.segment_sum(h_flat, flat_seg, num_segments=num_segments)
+    G = G[:-1].reshape(num_nodes, d, num_bins)
+    H = H[:-1].reshape(num_nodes, d, num_bins)
     if axis_name is not None:
-        GH = jax.lax.psum(GH, axis_name)
-    return GH[..., 0], GH[..., 1]
+        G = jax.lax.psum(G, axis_name)
+        H = jax.lax.psum(H, axis_name)
+    return G, H

@@ -143,12 +143,17 @@ class Cluster:
         )
 
 
-def distributed_run(exec_fun, args, include_in_training, hosts, current_host, port=DEFAULT_PORT):
+def distributed_run(
+    exec_fun, args, include_in_training, hosts, current_host, port=DEFAULT_PORT, pre_exec=None
+):
     """Membership-aware distributed execution (the reference's rabit_run).
 
     1. allgather {host, include_in_training};
     2. hosts without data log and exit(0) — the cluster re-forms without them;
-    3. the rest run ``exec_fun(**args, is_master=...)`` where master is the
+    3. ``pre_exec(participating_hosts, current_host)`` runs on every
+       participant (jax.distributed bring-up for the re-formed cluster — the
+       analog of the reference's second rabit init, distributed.py:88-106);
+    4. the rest run ``exec_fun(**args, is_master=...)`` where master is the
        first participating host in sorted order.
     """
     cluster = Cluster(hosts, current_host, port=port)
@@ -168,6 +173,8 @@ def distributed_run(exec_fun, args, include_in_training, hosts, current_host, po
             "Host %s does not have data, exiting from cluster.", current_host
         )
         return None
+    if pre_exec is not None:
+        pre_exec(participating, current_host)
     is_master = participating[0] == current_host
     args = dict(args)
     args["is_master"] = is_master

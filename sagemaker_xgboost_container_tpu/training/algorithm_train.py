@@ -158,6 +158,7 @@ def sagemaker_train(
             include_in_training=include_in_training,
             hosts=sm_hosts,
             current_host=sm_current_host,
+            pre_exec=maybe_init_jax_distributed,
         )
     elif num_hosts == 1:
         if train_dmatrix:
@@ -172,11 +173,76 @@ def sagemaker_train(
         raise exc.PlatformError("Number of hosts should be an int greater than or equal to 1")
 
 
+def _training_mesh(num_devices_cap=None):
+    """Data-parallel mesh over every visible device (None on one device).
+
+    Under multi-host ``jax.distributed``, jax.devices() spans the whole job,
+    so the same Mesh construction covers pod-scale data parallelism — the TPU
+    replacement for the reference's Rabit worker group (SURVEY.md §2.3).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    if num_devices_cap:
+        n = min(n, int(num_devices_cap))
+    if n <= 1:
+        return None
+    return Mesh(np.array(devices[:n]), axis_names=("data",))
+
+
+def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
+    """Bring up the multi-host XLA runtime (coordinator = sorted hosts[0]).
+
+    Mirrors the reference's deterministic rank convention
+    (distributed.py:155,:207). Gated to accelerator platforms: the CPU
+    simulation tests drive the mesh path in-process instead.
+    """
+    import jax
+
+    if len(sm_hosts) <= 1:
+        return False
+    if os.environ.get("SM_JAX_DISTRIBUTED", "auto") == "off":
+        return False
+    if jax.default_backend() == "cpu":
+        logger.info("Skipping jax.distributed on the CPU backend")
+        return False
+    hosts = sorted(sm_hosts)
+    try:
+        jax.distributed.initialize(
+            coordinator_address="{}:{}".format(hosts[0], port),
+            num_processes=len(hosts),
+            process_id=hosts.index(sm_current_host),
+        )
+        logger.info(
+            "jax.distributed up: %d processes, %d global devices",
+            len(hosts),
+            jax.device_count(),
+        )
+        return True
+    except Exception as e:
+        raise exc.PlatformError(
+            "Failed to initialize the multi-host XLA runtime", caused_by=e
+        )
+
+
 def train_job(
     train_cfg, train_dmatrix, val_dmatrix, train_val_dmatrix, model_dir, checkpoint_dir, is_master
 ):
     """Run boosting (or repeated k-fold CV) on this node; save master-only."""
     train_cfg = dict(train_cfg)
+    mesh = _training_mesh(train_cfg.pop("_num_devices", None))
+    objective_name = train_cfg.get("objective") or ""
+    if mesh is not None and (
+        objective_name.startswith("rank:") or objective_name == "survival:cox"
+    ):
+        logger.warning(
+            "Objective %s does not support data-parallel meshes yet; training on "
+            "a single device.",
+            objective_name,
+        )
+        mesh = None
     num_round = train_cfg.pop("num_round")
     save_model_on_termination = train_cfg.pop("save_model_on_termination", "false")
 
@@ -235,6 +301,7 @@ def train_job(
                     feval=configured_feval,
                     callbacks=callbacks,
                     xgb_model=xgb_model,
+                    mesh=mesh,
                 )
         else:
             num_cv_round = train_cfg.pop("_num_cv_round", 1)
@@ -294,6 +361,7 @@ def train_job(
                     feval=configured_feval,
                     callbacks=callbacks + [recorder],
                     xgb_model=xgb_model,
+                    mesh=mesh,
                 )
                 bst.append(fold_booster)
                 evals_results.append(recorder.log)

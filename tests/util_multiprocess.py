@@ -1,0 +1,42 @@
+"""Spawn-safe workers for multi-process jax.distributed tests."""
+
+
+def distributed_train_worker(rank, world, port, q):
+    """One process of a 2-process CPU 'pod': trains on its own row shard."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world,
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(800, 4).astype(np.float32)
+    y = (3 * X[:, 0] + np.sin(5 * X[:, 1])).astype(np.float32)
+    half = 400
+    lo, hi = rank * half, (rank + 1) * half
+    dtrain = DataMatrix(X[lo:hi], labels=y[lo:hi])
+
+    devices = np.array(jax.devices())  # 4 global devices (2 per process)
+    mesh = Mesh(devices, axis_names=("data",))
+
+    forest = train(
+        {"max_depth": 3, "eta": 0.3, "max_bin": 64, "seed": 1},
+        dtrain,
+        num_boost_round=5,
+        mesh=mesh,
+    )
+    preds = forest.predict(X[:50])
+    q.put((rank, np.asarray(preds)))

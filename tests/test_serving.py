@@ -324,3 +324,38 @@ class TestMultiModelEndpoint:
             assert status == 404
         finally:
             httpd.shutdown()
+
+
+class TestScriptModeServing:
+    def test_user_hooks_through_real_server(self, tmp_path, monkeypatch):
+        # user module provides transform_fn + model_fn (reference
+        # test_abalone.py custom transform_fn scenario)
+        code_dir = tmp_path / "code"
+        code_dir.mkdir()
+        (code_dir / "inference.py").write_text(
+            "def model_fn(model_dir):\n"
+            "    return 'sentinel-model'\n"
+            "\n"
+            "def transform_fn(model, payload, content_type, accept):\n"
+            "    assert model == 'sentinel-model'\n"
+            "    return 'echo:' + payload.decode(), 'text/csv'\n"
+        )
+        monkeypatch.setenv("SAGEMAKER_PROGRAM", "inference.py")
+        monkeypatch.setenv("SAGEMAKER_SUBMIT_DIRECTORY", str(code_dir))
+        monkeypatch.setenv("SM_MODEL_DIR", str(tmp_path))
+
+        from sagemaker_xgboost_container_tpu.serving.server import build_app
+
+        app = build_app()
+        base, httpd = _serve(app)
+        try:
+            status, body, _ = _request(
+                base + "/invocations",
+                method="POST",
+                data=b"1,2,3",
+                headers={"Content-Type": "text/csv"},
+            )
+            assert status == 200, body
+            assert body == b"echo:1,2,3"
+        finally:
+            httpd.shutdown()

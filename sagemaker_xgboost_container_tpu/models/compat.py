@@ -159,6 +159,63 @@ def decode_ubjson(buf):
     return _UbjReader(buf).read_value()
 
 
+def _ubj_int(value):
+    if -128 <= value <= 127:
+        return b"i" + struct.pack("b", value)
+    if 0 <= value <= 255:
+        return b"U" + struct.pack("B", value)
+    if -(2**15) <= value < 2**15:
+        return b"I" + struct.pack(">h", value)
+    if -(2**31) <= value < 2**31:
+        return b"l" + struct.pack(">i", value)
+    return b"L" + struct.pack(">q", value)
+
+
+def _ubj_str_payload(s):
+    raw = s.encode("utf-8")
+    return _ubj_int(len(raw)) + raw
+
+
+def encode_ubjson(obj):
+    """Draft-12 UBJSON encoder for the subset the model document uses."""
+    out = io.BytesIO()
+
+    def write(o):
+        if o is None:
+            out.write(b"Z")
+        elif o is True:
+            out.write(b"T")
+        elif o is False:
+            out.write(b"F")
+        elif isinstance(o, (int, np.integer)):
+            out.write(_ubj_int(int(o)))
+        elif isinstance(o, (float, np.floating)):
+            out.write(b"D" + struct.pack(">d", float(o)))
+        elif isinstance(o, str):
+            out.write(b"S" + _ubj_str_payload(o))
+        elif isinstance(o, dict):
+            out.write(b"{")
+            for key, value in o.items():
+                out.write(_ubj_str_payload(str(key)))
+                write(value)
+            out.write(b"}")
+        elif isinstance(o, (list, tuple, np.ndarray)):
+            seq = list(o)
+            if seq and all(isinstance(v, (float, np.floating)) for v in seq):
+                out.write(b"[$D#" + _ubj_int(len(seq)))
+                out.write(struct.pack(">{}d".format(len(seq)), *map(float, seq)))
+            else:
+                out.write(b"[")
+                for v in seq:
+                    write(v)
+                out.write(b"]")
+        else:
+            raise TypeError("cannot UBJSON-encode {!r}".format(type(o)))
+
+    write(obj)
+    return out.getvalue()
+
+
 # ---------------------------------------------------------------------------
 # Legacy binary model format (xgboost "deprecated" serialization)
 # ---------------------------------------------------------------------------

@@ -415,7 +415,19 @@ class Forest:
             )
         return forest
 
-    def save_model(self, path):
+    def save_model(self, path, model_format=None):
+        """Write the model; format by explicit arg or .ubj extension
+        (mirrors xgboost's extension-driven choice), JSON otherwise."""
+        if model_format is None:
+            model_format = "ubj" if str(path).endswith(".ubj") else "json"
+        if model_format == "ubj":
+            import json as json_mod
+
+            from .compat import encode_ubjson
+
+            with open(path, "wb") as f:
+                f.write(encode_ubjson(json_mod.loads(self.save_json())))
+            return
         with open(path, "w") as f:
             f.write(self.save_json())
 

@@ -234,3 +234,16 @@ def test_poisson_objective():
     p = forest.predict(X)
     assert (p > 0).all()
     assert np.corrcoef(p, lam)[0, 1] > 0.9
+
+
+def test_ubjson_save_roundtrip(tmp_path):
+    from sagemaker_xgboost_container_tpu.models.compat import load_model_any_format
+
+    X, y = _friedman(300)
+    forest = train({"max_depth": 3}, DataMatrix(X, labels=y), num_boost_round=3)
+    path = str(tmp_path / "model.ubj")
+    forest.save_model(path)
+    with open(path, "rb") as f:
+        assert f.read(1) == b"{"  # UBJ object marker, not JSON text
+    loaded, fmt = load_model_any_format(path)
+    np.testing.assert_allclose(loaded.predict(X), forest.predict(X), rtol=1e-6)

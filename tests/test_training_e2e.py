@@ -203,3 +203,48 @@ def test_csv_binary_logistic_with_accuracy_feval(tmp_path):
     assert re.search(r"\ttrain-logloss:\S+", result.stdout)
     assert re.search(r"\ttrain-accuracy:\S+", result.stdout)
     assert (model_dir / "xgboost-model").exists()
+
+
+@pytest.mark.e2e
+def test_sigterm_saves_intermediate_model(tmp_path):
+    """Fault injection: kill training mid-run; save_model_on_termination
+    leaves a loadable model and the process exits 0 (reference
+    test_early_stopping.py:35-68 semantics)."""
+    import signal
+    import time
+
+    env, model_dir, _ = _sm_env(
+        tmp_path,
+        {
+            "num_round": "100000",
+            "max_depth": "3",
+            "save_model_on_termination": "true",
+        },
+        LIBSVM_CHANNELS,
+        ABALONE + "/train",
+        ABALONE + "/validation",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sagemaker_xgboost_container_tpu.training.entry"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    # wait until at least one round has been logged, then SIGTERM
+    deadline = time.time() + 300
+    saw_round = False
+    while time.time() < deadline and not saw_round:
+        line = proc.stdout.readline()
+        if line.startswith("["):
+            saw_round = True
+    assert saw_round, "training never produced a round line"
+    time.sleep(2)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    assert rc == 0
+    assert (model_dir / "xgboost-model").exists()
+    from sagemaker_xgboost_container_tpu.models import Forest
+
+    forest = Forest.load_model(str(model_dir / "xgboost-model"))
+    assert forest.num_boosted_rounds >= 1

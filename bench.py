@@ -14,12 +14,50 @@ rounds/sec (BASELINE.json) — the reference publishes no numbers of its own
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
+
+# Self-supervision: the TPU tunnel in this environment can wedge indefinitely
+# (see memory: tpu-tunnel-quirks); the parent process runs the real benchmark
+# as a child under a hard timeout so ONE JSON line is always printed.
+BENCH_TIMEOUT_S = int(os.getenv("BENCH_TIMEOUT_S", "2400"))
+
+
+def _supervised_main():
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    try:
+        result = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=BENCH_TIMEOUT_S,
+        )
+        for line in reversed(result.stdout.splitlines()):
+            if line.startswith("{"):
+                print(line)
+                return
+        note = "benchmark child produced no result (rc={})".format(result.returncode)
+    except subprocess.TimeoutExpired:
+        note = "benchmark timed out after {}s (TPU tunnel unavailable?)".format(
+            BENCH_TIMEOUT_S
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "boosting rounds/sec (synthetic Higgs-like) — FAILED: " + note,
+                "value": 0.0,
+                "unit": "rounds/sec",
+                "vs_baseline": 0.0,
+            }
+        )
+    )
 
 N_ROWS = int(os.getenv("BENCH_ROWS", "1000000"))
 N_FEATURES = int(os.getenv("BENCH_FEATURES", "28"))
@@ -93,4 +131,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        _supervised_main()

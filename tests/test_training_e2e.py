@@ -248,3 +248,45 @@ def test_sigterm_saves_intermediate_model(tmp_path):
 
     forest = Forest.load_model(str(model_dir / "xgboost-model"))
     assert forest.num_boosted_rounds >= 1
+
+
+@pytest.mark.e2e
+def test_two_host_membership_dataless_host_exits(tmp_path):
+    """Reference distributed.py:78-109 semantics: in a 2-host cluster where
+    one host has no data, that host broadcasts membership, exits 0, and the
+    other host trains and saves the model."""
+    import time
+
+    hosts = ["127.0.0.1", "localhost"]
+    procs = {}
+    dirs = {}
+    for host in hosts:
+        hdir = tmp_path / host.replace(".", "_")
+        hdir.mkdir()
+        train_dir = hdir / "train"
+        train_dir.mkdir()
+        if host == "127.0.0.1":  # only the master host gets data
+            src = ABALONE + "/train/abalone.train_0"
+            (train_dir / "abalone.train_0").write_bytes(open(src, "rb").read())
+        env, model_dir, _ = _sm_env(
+            hdir,
+            {"num_round": "3", "max_depth": "3"},
+            {"train": LIBSVM_CHANNELS["train"]},
+            str(train_dir),
+            hosts=hosts,
+        )
+        env["SM_CURRENT_HOST"] = host
+        dirs[host] = model_dir
+        procs[host] = subprocess.Popen(
+            [sys.executable, "-m", "sagemaker_xgboost_container_tpu.training.entry"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+    outs = {h: p.communicate(timeout=300)[0] for h, p in procs.items()}
+    assert procs["localhost"].returncode == 0, outs["localhost"][-2000:]
+    assert procs["127.0.0.1"].returncode == 0, outs["127.0.0.1"][-2000:]
+    # exactly the data-holding host saved a model
+    assert (dirs["127.0.0.1"] / "xgboost-model").exists()
+    assert not (dirs["localhost"] / "xgboost-model").exists()

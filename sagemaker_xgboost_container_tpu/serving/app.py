@@ -47,12 +47,23 @@ class ScoringService:
         self.model_dir = model_dir or os.getenv(constants.SM_MODEL_DIR, "/opt/ml/model")
         self.model = None
         self.model_format = None
+        self._batcher = None
 
     def load_model(self):
         if self.model is None:
             self.model, self.model_format = serve_utils.get_loaded_booster(
                 self.model_dir, serve_utils.is_ensemble_enabled()
             )
+            if not isinstance(self.model, list) and os.getenv(
+                "SAGEMAKER_SERVING_BATCHING", "true"
+            ).lower() == "true":
+                from .batcher import PredictBatcher
+
+                model = self.model
+                rng = serve_utils.best_iteration_range(model)
+                self._batcher = PredictBatcher(
+                    lambda feats: model.predict(feats, iteration_range=rng)
+                )
         return self.model_format
 
     @property
@@ -66,6 +77,14 @@ class ScoringService:
         return str(model.num_class or "") if model else ""
 
     def predict(self, dtest, content_type):
+        if self._batcher is not None:
+            from ..data.content_types import get_content_type
+
+            serve_utils._check_feature_count(
+                self.model, dtest, get_content_type(content_type)
+            )
+            feats = serve_utils.canonicalize_features(self.model, dtest)
+            return self._batcher.predict(feats)
         return serve_utils.predict(
             self.model, self.model_format, dtest, content_type, objective=self.objective
         )

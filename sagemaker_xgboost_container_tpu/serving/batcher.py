@@ -1,0 +1,97 @@
+"""Request coalescing for TPU serving.
+
+The reference scales serving by forking a gunicorn worker per CPU, each with
+its own model copy (serve.py:38-39, :92-107). On TPU one process owns the
+chip, so throughput under concurrency comes from *batching*: concurrent
+/invocations requests are coalesced into one padded forest-kernel dispatch
+and the per-row results are scattered back to their callers.
+
+A single daemon worker drains the queue; callers block on an Event with a
+timeout. Batching is shape-safe: requests joining a batch must share the
+feature width (they do — one model per endpoint); row counts concatenate and
+the predict path's power-of-two bucketing keeps the jit cache small.
+"""
+
+import logging
+import queue
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _Pending:
+    __slots__ = ("features", "event", "result", "error")
+
+    def __init__(self, features):
+        self.features = features
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class PredictBatcher:
+    """Coalesce predict calls into batched kernel dispatches.
+
+    ``predict_fn(features) -> np.ndarray`` must be thread-safe (ours is: a
+    pure jitted kernel). ``max_batch_rows`` bounds padding waste;
+    ``max_wait_ms`` bounds added latency under low load.
+    """
+
+    def __init__(self, predict_fn, max_batch_rows=16384, max_wait_ms=2.0):
+        self.predict_fn = predict_fn
+        self.max_batch_rows = max_batch_rows
+        self.max_wait_ms = max_wait_ms
+        self._queue = queue.Queue()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def predict(self, features, timeout=60.0):
+        pending = _Pending(np.asarray(features, np.float32))
+        self._queue.put(pending)
+        if not pending.event.wait(timeout):
+            raise TimeoutError("prediction timed out in the batch queue")
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    # ------------------------------------------------------------------ int
+    def _drain_batch(self, first):
+        batch = [first]
+        rows = first.features.shape[0]
+        deadline_wait = self.max_wait_ms / 1000.0
+        while rows < self.max_batch_rows:
+            try:
+                nxt = self._queue.get(timeout=deadline_wait)
+            except queue.Empty:
+                break
+            if nxt.features.shape[1] != first.features.shape[1]:
+                # different width (e.g. mid-flight model swap): run separately
+                self._queue.put(nxt)
+                break
+            batch.append(nxt)
+            rows += nxt.features.shape[0]
+        return batch
+
+    def _worker(self):
+        while True:
+            first = self._queue.get()
+            batch = self._drain_batch(first)
+            try:
+                stacked = (
+                    batch[0].features
+                    if len(batch) == 1
+                    else np.concatenate([p.features for p in batch], axis=0)
+                )
+                out = np.asarray(self.predict_fn(stacked))
+                offset = 0
+                for pending in batch:
+                    k = pending.features.shape[0]
+                    pending.result = out[offset : offset + k]
+                    offset += k
+                    pending.event.set()
+            except Exception as e:  # propagate to every caller in the batch
+                for pending in batch:
+                    pending.error = e
+                    pending.event.set()

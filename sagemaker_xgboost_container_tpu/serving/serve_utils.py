@@ -161,6 +161,23 @@ def _check_feature_count(forest, dtest, content_type):
         raise ValueError("Content type {} is not supported".format(content_type))
 
 
+def canonicalize_features(forest, dtest):
+    """Width-adjust request features to the model's expectation."""
+    features = dtest.features
+    if features.shape[1] < forest.num_feature:
+        features = dtest.pad_features(forest.num_feature).features
+    elif features.shape[1] > forest.num_feature:
+        features = features[:, : forest.num_feature]
+    return features
+
+
+def best_iteration_range(forest):
+    best_iteration = forest.attributes.get("best_iteration")
+    if best_iteration is None:
+        return None
+    return (0, int(best_iteration) + 1)
+
+
 def predict(model, model_format, dtest, input_content_type, objective=None):
     """Run (possibly ensemble) prediction with feature-size validation."""
     boosters = model if isinstance(model, list) else [model]
@@ -168,16 +185,10 @@ def predict(model, model_format, dtest, input_content_type, objective=None):
     _check_feature_count(boosters[0], dtest, content_type)
 
     def _one(forest):
-        features = dtest.features
-        if features.shape[1] < forest.num_feature:
-            features = dtest.pad_features(forest.num_feature).features
-        elif features.shape[1] > forest.num_feature:
-            features = features[:, : forest.num_feature]
-        best_iteration = forest.attributes.get("best_iteration")
-        iteration_range = None
-        if best_iteration is not None:
-            iteration_range = (0, int(best_iteration) + 1)
-        return forest.predict(features, iteration_range=iteration_range)
+        return forest.predict(
+            canonicalize_features(forest, dtest),
+            iteration_range=best_iteration_range(forest),
+        )
 
     if isinstance(model, list):
         outs = [_one(b) for b in boosters]

@@ -359,3 +359,56 @@ class TestScriptModeServing:
             assert body == b"echo:1,2,3"
         finally:
             httpd.shutdown()
+
+
+class TestBatcher:
+    def test_coalesces_concurrent_requests(self):
+        import threading as th
+
+        from sagemaker_xgboost_container_tpu.serving.batcher import PredictBatcher
+
+        calls = []
+
+        def fake_predict(feats):
+            calls.append(feats.shape[0])
+            return feats[:, 0] * 2
+
+        batcher = PredictBatcher(fake_predict, max_wait_ms=50)
+        results = {}
+
+        def issue(i):
+            x = np.full((3, 2), float(i), np.float32)
+            results[i] = batcher.predict(x)
+
+        threads = [th.Thread(target=issue, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for i in range(8):
+            np.testing.assert_allclose(results[i], [2.0 * i] * 3)
+        # fewer kernel calls than requests => coalescing happened
+        assert len(calls) < 8, calls
+        assert sum(calls) == 24
+
+    def test_error_propagates(self):
+        from sagemaker_xgboost_container_tpu.serving.batcher import PredictBatcher
+
+        def boom(feats):
+            raise ValueError("bad batch")
+
+        batcher = PredictBatcher(boom)
+        with pytest.raises(ValueError, match="bad batch"):
+            batcher.predict(np.zeros((2, 2), np.float32))
+
+    def test_served_predictions_match_direct(self, abalone_model_dir):
+        svc = ScoringService(abalone_model_dir)
+        svc.load_model()
+        from sagemaker_xgboost_container_tpu.serving.encoder import libsvm_to_matrix
+
+        dtest = libsvm_to_matrix(LIBSVM_PAYLOAD)
+        batched = svc.predict(dtest, "text/libsvm")
+        direct = serve_utils.predict(
+            svc.model, svc.model_format, dtest, "text/libsvm", svc.objective
+        )
+        np.testing.assert_allclose(np.asarray(batched), np.asarray(direct), rtol=1e-6)

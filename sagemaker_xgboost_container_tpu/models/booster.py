@@ -133,6 +133,41 @@ def _eval_metric_names(config, objective):
     return list(metrics)
 
 
+def _merged_distributed_cuts(dtrain, max_bin):
+    """Allgather per-host cut candidates and deterministically merge them.
+
+    Every process computes shard-local quantile cuts, gathers all hosts'
+    candidates, and re-selects <= max_bin - 1 evenly spaced thresholds from
+    the sorted union. Deterministic: identical inputs on every host yield
+    identical cuts everywhere.
+    """
+    from jax.experimental import multihost_utils
+
+    from ..data.binning import compute_cut_points
+
+    local_cuts = compute_cut_points(dtrain.features, dtrain.weights, max_bin)
+    width = max_bin - 1
+    d = dtrain.num_col
+    mat = np.full((d, width), np.nan, np.float32)
+    counts = np.zeros(d, np.int32)
+    for f, c in enumerate(local_cuts):
+        mat[f, : len(c)] = c
+        counts[f] = len(c)
+    all_mats = np.asarray(multihost_utils.process_allgather(mat))       # [P, d, W]
+    all_counts = np.asarray(multihost_utils.process_allgather(counts))  # [P, d]
+    merged = []
+    for f in range(d):
+        cands = np.concatenate(
+            [all_mats[p, f, : all_counts[p, f]] for p in range(all_mats.shape[0])]
+        )
+        cands = np.unique(cands[np.isfinite(cands)])
+        if len(cands) > width:
+            picks = np.linspace(0, len(cands) - 1, width).round().astype(int)
+            cands = cands[np.unique(picks)]
+        merged.append(cands.astype(np.float32))
+    return merged
+
+
 def _pad_rows(array, target_rows, fill):
     n = array.shape[0]
     if n == target_rows:
@@ -189,25 +224,10 @@ class _TrainingSession:
         shared_cuts = None
         if self.is_multiprocess:
             # every host must bin with identical thresholds or the psum'd
-            # histograms are meaningless; host 0's shard-local quantile cuts
-            # are broadcast to all (a sketch approximation of the global
-            # quantiles — a mergeable distributed sketch can replace this)
-            from jax.experimental import multihost_utils
-
-            from ..data.binning import compute_cut_points
-
-            local_cuts = compute_cut_points(
-                dtrain.features, dtrain.weights, config.max_bin
-            )
-            width = config.max_bin - 1
-            mat = np.full((dtrain.num_col, width), np.inf, np.float32)
-            counts = np.zeros(dtrain.num_col, np.int32)
-            for f, c in enumerate(local_cuts):
-                mat[f, : len(c)] = c
-                counts[f] = len(c)
-            mat = np.asarray(multihost_utils.broadcast_one_to_all(mat))
-            counts = np.asarray(multihost_utils.broadcast_one_to_all(counts))
-            shared_cuts = [mat[f, : counts[f]] for f in range(dtrain.num_col)]
+            # histograms are meaningless: merge the per-host quantile sketches
+            # (allgather candidate cuts, union, re-select) — the TPU analog of
+            # xgboost's allreduced weighted quantile sketch
+            shared_cuts = _merged_distributed_cuts(dtrain, config.max_bin)
 
         self.train_binned = bin_matrix(dtrain, config.max_bin, cut_points=shared_cuts)
         self.cuts = self.train_binned.cut_points

@@ -19,6 +19,49 @@ import numpy as np
 _SIGMA = 1.0
 
 
+def map_exchange_delta(S, Y, valid):
+    """Exact |delta AP| for every intra-group pair swap (binary relevance).
+
+    S, Y, valid: [G, M] scores / labels / validity. For a pair with the
+    relevant doc at rank p above the irrelevant at rank q:
+    |dAP| = (C(p)/p - C(q)/q + Sum_{k in (p,q)} rel_k/k) / R, with the
+    symmetric +1/r_u correction when the relevant doc is the lower one;
+    C(k) = #relevant in top-k. Verified against brute-force AP recomputation
+    in tests/test_map_delta.py.
+    """
+    G, M = S.shape
+    rel = jnp.where(valid, (Y > 0).astype(jnp.float32), 0.0)
+    order_key = jnp.where(valid, -S, jnp.inf)
+    order = jnp.argsort(order_key, axis=1)
+    ranks = jnp.argsort(order, axis=1) + 1                      # [G, M]
+    rel_sorted = jnp.take_along_axis(rel, order, axis=1)
+    C_sorted = jnp.cumsum(rel_sorted, axis=1)
+    k_pos = jnp.arange(1, M + 1, dtype=jnp.float32)[None, :]
+    S_sorted = jnp.cumsum(rel_sorted / k_pos, axis=1)
+    inv_order = ranks - 1                                       # inverse perm
+    C_i = jnp.take_along_axis(C_sorted, inv_order, axis=1)      # C(r_i)
+    S_i = jnp.take_along_axis(S_sorted, inv_order, axis=1)      # S(r_i)
+    r_f = ranks.astype(jnp.float32)
+    R_total = jnp.maximum(rel.sum(axis=1), 1.0)[:, None, None]
+    upper_is_i = (ranks[:, :, None] < ranks[:, None, :]).astype(jnp.float32)
+
+    def pick(a):
+        ai, aj = a[:, :, None], a[:, None, :]
+        return upper_is_i * ai + (1 - upper_is_i) * aj, (
+            upper_is_i * aj + (1 - upper_is_i) * ai
+        )
+
+    r_u, r_l = pick(r_f)
+    C_u, C_l = pick(C_i)
+    S_u, S_l = pick(S_i)
+    rel_u, rel_l = pick(rel)
+    core = (
+        C_u / r_u + (1.0 - rel_u) / r_u - C_l / r_l + (S_l - rel_l / r_l) - S_u
+    )
+    differs = jnp.abs(rel[:, :, None] - rel[:, None, :])
+    return jnp.abs(core) * differs / R_total
+
+
 def build_group_layout(groups, max_group_size=None):
     """Group-size array -> (row_index [G, M] int32 with -1 padding).
 
@@ -43,8 +86,8 @@ def lambdarank_grad_hess(
     """Per-row (grad, hess) for LambdaMART.
 
     margins/labels/weights: [n]; row_index: [G, M] with -1 padding;
-    scheme: "pairwise" | "ndcg" | "map" (map uses pairwise weighting — the
-    rank position exchange delta for MAP is approximated by 1).
+    scheme: "pairwise" (delta = 1) | "ndcg" (|delta NDCG|) | "map" (exact
+    |delta AP| exchange weights, binary relevance = label > 0).
 
     The O(M^2) pairwise tensors are materialized ``group_chunk`` groups at a
     time via ``lax.map`` so web-scale group counts (MSLR: ~30k queries x up
@@ -97,45 +140,7 @@ def _lambdarank_block(margins, labels, weights, row_index, scheme):
             / max_dcg[:, None, None]
         )
     elif scheme == "map":
-        # exact |delta AP| for swapping a relevant/irrelevant pair:
-        # |dAP(p<q)| = (C(p)/p - C(q)/q + S(q-1) - S(p)) / R with
-        # C(k) = #relevant in top-k, S(k) = sum_{j<=k} rel_j / j.
-        rel = jnp.where(valid, (Y > 0).astype(jnp.float32), 0.0)
-        order_key = jnp.where(valid, -S, jnp.inf)
-        order = jnp.argsort(order_key, axis=1)
-        ranks = jnp.argsort(order, axis=1) + 1                      # [G, M]
-        rel_sorted = jnp.take_along_axis(rel, order, axis=1)
-        C_sorted = jnp.cumsum(rel_sorted, axis=1)
-        k_pos = jnp.arange(1, M + 1, dtype=jnp.float32)[None, :]
-        S_sorted = jnp.cumsum(rel_sorted / k_pos, axis=1)
-        inv_order = jnp.argsort(order, axis=1)
-        C_i = jnp.take_along_axis(C_sorted, inv_order, axis=1)      # C(r_i)
-        S_i = jnp.take_along_axis(S_sorted, inv_order, axis=1)      # S(r_i)
-        r_f = ranks.astype(jnp.float32)
-        R_total = jnp.maximum(rel.sum(axis=1), 1.0)[:, None, None]
-        upper_is_i = (ranks[:, :, None] < ranks[:, None, :]).astype(jnp.float32)
-
-        def pick(a):
-            ai, aj = a[:, :, None], a[:, None, :]
-            return upper_is_i * ai + (1 - upper_is_i) * aj, (
-                upper_is_i * aj + (1 - upper_is_i) * ai
-            )
-
-        r_u, r_l = pick(r_f)
-        C_u, C_l = pick(C_i)
-        S_u, S_l = pick(S_i)
-        rel_u, rel_l = pick(rel)
-        # |dAP| for swapping upper u with lower l (binary relevance):
-        #   C(r_u)/r_u + [rel_u==0]/r_u - C(r_l)/r_l + S(r_l-1) - S(r_u), /R
-        core = (
-            C_u / r_u
-            + (1.0 - rel_u) / r_u
-            - C_l / r_l
-            + (S_l - rel_l / r_l)
-            - S_u
-        )
-        differs = jnp.abs(rel[:, :, None] - rel[:, None, :])
-        delta = jnp.abs(core) * differs / R_total
+        delta = map_exchange_delta(S, Y, valid)
     else:
         delta = 1.0
 

@@ -17,38 +17,14 @@ def _average_precision(scores, rel):
 
 
 def _impl_delta(scores, rel):
-    """Run the scheme='map' delta computation exactly as _lambdarank_block."""
+    """Call the production map-delta helper directly."""
+    import jax.numpy as jnp
+
     m = len(scores)
     S = jnp.asarray(scores)[None, :]
     Y = jnp.asarray(rel)[None, :]
     valid = jnp.ones((1, m), bool)
-    relv = jnp.where(valid, (Y > 0).astype(jnp.float32), 0.0)
-    order = jnp.argsort(jnp.where(valid, -S, jnp.inf), axis=1)
-    ranks = jnp.argsort(order, axis=1) + 1
-    rel_sorted = jnp.take_along_axis(relv, order, axis=1)
-    C_sorted = jnp.cumsum(rel_sorted, axis=1)
-    k_pos = jnp.arange(1, m + 1, dtype=jnp.float32)[None, :]
-    S_sorted = jnp.cumsum(rel_sorted / k_pos, axis=1)
-    inv = jnp.argsort(order, axis=1)
-    C_i = jnp.take_along_axis(C_sorted, inv, axis=1)
-    S_i = jnp.take_along_axis(S_sorted, inv, axis=1)
-    r_f = ranks.astype(jnp.float32)
-    R_total = jnp.maximum(relv.sum(axis=1), 1.0)[:, None, None]
-    upper_is_i = (ranks[:, :, None] < ranks[:, None, :]).astype(jnp.float32)
-
-    def pick(a):
-        ai, aj = a[:, :, None], a[:, None, :]
-        return upper_is_i * ai + (1 - upper_is_i) * aj, (
-            upper_is_i * aj + (1 - upper_is_i) * ai
-        )
-
-    r_u, r_l = pick(r_f)
-    C_u, C_l = pick(C_i)
-    S_u, S_l = pick(S_i)
-    rel_u, rel_l = pick(relv)
-    core = C_u / r_u + (1.0 - rel_u) / r_u - C_l / r_l + (S_l - rel_l / r_l) - S_u
-    differs = jnp.abs(relv[:, :, None] - relv[:, None, :])
-    return np.asarray(jnp.abs(core) * differs / R_total)[0]
+    return np.asarray(R.map_exchange_delta(S, Y, valid))[0]
 
 
 def test_map_delta_matches_bruteforce():

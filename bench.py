@@ -105,13 +105,13 @@ def main():
 
     done = 0
     while done < WARMUP_ROUNDS:
-        done += len(session.run_rounds())
+        done += len(session.run_rounds()[0])
     jax.block_until_ready(session.margins)
 
     start = time.perf_counter()
     done = 0
     while done < BENCH_ROUNDS:
-        done += len(session.run_rounds())
+        done += len(session.run_rounds()[0])
     jax.block_until_ready(session.margins)
     elapsed = time.perf_counter() - start
 

@@ -168,6 +168,21 @@ def _merged_distributed_cuts(dtrain, max_bin):
     return merged
 
 
+def _apply_packed_tree(packed, bins, margins, num_group, num_parallel, depth, num_bins):
+    """margins += the packed tree's (or tree stack's) outputs on ``bins``."""
+    tree = tree_from_packed(packed)
+    if num_group == 1:
+        if num_parallel > 1:
+            delta = jax.vmap(lambda t: predict_binned(t, bins, depth, num_bins))(
+                tree
+            ).sum(axis=0)
+        else:
+            delta = predict_binned(tree, bins, depth, num_bins)
+        return margins + delta
+    deltas = jax.vmap(lambda t: predict_binned(t, bins, depth, num_bins))(tree)
+    return margins + deltas.T
+
+
 def _pad_rows(array, target_rows, fill):
     n = array.shape[0]
     if n == target_rows:
@@ -179,7 +194,9 @@ def _pad_rows(array, target_rows, fill):
 class _TrainingSession:
     """Device state for one training run (bins, margins, jitted round fns)."""
 
-    def __init__(self, config, dtrain, evals, forest, mesh=None):
+    def __init__(
+        self, config, dtrain, evals, forest, mesh=None, metric_names=None, has_feval=False
+    ):
         self.config = config
         self.objective = forest.objective()
         self.num_group = self.objective.num_output_group
@@ -272,17 +289,26 @@ class _TrainingSession:
         else:
             self.margins = _put(np.full(shape, base, np.float32), margin_spec)
 
-        # eval-set device state: bins cached once, margins incremental
+        # eval-set device state: bins cached once, margins incremental;
+        # labels/weights kept on device for batched device-side metrics
         self.eval_bins = []
         self.eval_margins = []
+        self.eval_labels = []
+        self.eval_weights = []
         for name, dm, binned in self.eval_sets:
             if binned is self.train_binned:
                 self.eval_bins.append(None)     # shares training margins
                 self.eval_margins.append(None)
+                self.eval_labels.append(self.labels)
+                self.eval_weights.append(self.weights)
                 continue
             m_pad = -(-dm.num_row // self.pad_unit) * self.pad_unit
             self.eval_bins.append(
                 _put(_pad_rows(binned.bins, m_pad, binned.max_bin), P("data", None))
+            )
+            self.eval_labels.append(_put(_pad_rows(dm.labels, m_pad, 0.0), P("data")))
+            self.eval_weights.append(
+                _put(_pad_rows(dm.get_weight(), m_pad, 0.0), P("data"))
             )
             eshape = (m_pad,) if self.num_group == 1 else (m_pad, self.num_group)
             if forest.trees:
@@ -298,12 +324,26 @@ class _TrainingSession:
         self.rng = jax.random.PRNGKey(config.seed)
 
         self.rounds_per_dispatch = max(1, config.rounds_per_dispatch)
+        self.device_metric_fns = None
         if self.rounds_per_dispatch > 1 and self.eval_sets:
-            logger.warning(
-                "_rounds_per_dispatch > 1 needs per-round eval margins; falling "
-                "back to 1 because eval sets are attached."
-            )
-            self.rounds_per_dispatch = 1
+            # batching stays possible when every watched metric computes on
+            # device: per-round scalars (for every eval set) ride back with
+            # the batch (device_metrics.py). Mesh runs keep K=1: nonlinear
+            # metrics (rmse/rmsle) don't combine exactly from per-shard means.
+            if not self.is_ranking and metric_names and not has_feval and mesh is None:
+                from .device_metrics import all_supported
+
+                self.device_metric_fns = all_supported(
+                    metric_names, self.objective.name, self.num_group
+                )
+            if self.device_metric_fns is None:
+                logger.warning(
+                    "_rounds_per_dispatch > 1 needs device-computable per-round "
+                    "eval metrics; falling back to 1."
+                )
+                self.rounds_per_dispatch = 1
+            else:
+                self.device_metric_names = list(metric_names)
 
         monotone = np.zeros(dtrain.num_col, np.int32)
         if config.monotone_constraints:
@@ -431,12 +471,21 @@ class _TrainingSession:
         colsample = cfg.colsample_bytree
         d = self.train_binned.num_col
 
-        def multi_round(bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone):
+        metric_fns = self.device_metric_fns
+        shared_flags = [b is None for b in self.eval_bins]
+        eval_bins_ns = [b for b in self.eval_bins if b is not None]
+        eval_labels = list(self.eval_labels)
+        eval_weights = list(self.eval_weights)
+        predict_depth = cfg.predict_depth
+
+        def multi_round(
+            bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone, eval_m
+        ):
             # lax.scan so the round body is compiled ONCE regardless of K
             k_features = max(1, int(round(colsample * d)))
 
             def body(carry, j):
-                margins_c = carry
+                margins_c, extra = carry
                 rng_j = jax.random.fold_in(rng, j)
                 if colsample < 1.0:
                     # same exactly-k-without-replacement draw as the host path
@@ -449,14 +498,44 @@ class _TrainingSession:
                 packed, margins_c = one_round(
                     bins, margins_c, labels, weights, num_cuts, rng_j, mask, monotone
                 )
-                return margins_c, packed
+                if metric_fns:
+                    new_extra = []
+                    per_set = []
+                    ei = 0
+                    for si, shared in enumerate(shared_flags):
+                        if shared:
+                            m_e = margins_c
+                        else:
+                            m_e = _apply_packed_tree(
+                                packed, eval_bins_ns[ei], extra[ei],
+                                num_group, num_parallel, predict_depth, num_bins,
+                            )
+                            new_extra.append(m_e)
+                            ei += 1
+                        per_set.append(
+                            jnp.stack(
+                                [
+                                    fn(m_e, eval_labels[si], eval_weights[si])
+                                    for fn in metric_fns
+                                ]
+                            )
+                        )
+                    scalars = jnp.stack(per_set)          # [n_sets, n_metrics]
+                    extra = tuple(new_extra)
+                else:
+                    scalars = jnp.zeros((0, 0), jnp.float32)
+                return (margins_c, extra), (packed, scalars)
 
-            margins, packed_all = jax.lax.scan(body, margins, jnp.arange(K))
-            return packed_all, margins
+            (margins, eval_m), (packed_all, metrics_all) = jax.lax.scan(
+                body, (margins, eval_m), jnp.arange(K)
+            )
+            return packed_all, metrics_all, margins, eval_m
 
         fn = one_round if K == 1 else multi_round
         if self.mesh is None:
-            return jax.jit(fn, donate_argnums=(1,))
+            if K == 1:
+                return jax.jit(fn, donate_argnums=(1,))
+            return jax.jit(fn, donate_argnums=(1, 8))
 
         margin_spec = P("data") if num_group == 1 else P("data", None)
         mapped = shard_map(
@@ -512,7 +591,10 @@ class _TrainingSession:
 
     # ---------------------------------------------------------------- round
     def run_rounds(self):
-        """One device dispatch -> list of rounds_per_dispatch host tree dicts."""
+        """One device dispatch -> (list of host tree dicts, metrics or None).
+
+        metrics: [K, n_metrics] numpy when device metrics are active (batched
+        mode); None when evaluation happens host-side (K=1)."""
         self.rng, sub, colrng = jax.random.split(self.rng, 3)
         d = self.bins.shape[1]
         if self.config.colsample_bytree < 1.0:
@@ -521,7 +603,7 @@ class _TrainingSession:
             feature_mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
         else:
             feature_mask = jnp.ones(d, jnp.float32)
-        packed, self.margins = self._round_fn(
+        args = (
             self.bins,
             self.margins,
             self.labels,
@@ -532,14 +614,26 @@ class _TrainingSession:
             self.monotone,
         )
         if self.rounds_per_dispatch == 1:
+            packed, self.margins = self._round_fn(*args)
             for i in range(len(self.eval_sets)):
                 if self.eval_margins[i] is not None:
                     self.eval_margins[i] = self._apply_fn(
                         packed, self.eval_bins[i], self.eval_margins[i]
                     )
-            return [unpack_tree(np.asarray(packed))]
+            return [unpack_tree(np.asarray(packed))], None
+        eval_m = tuple(m for m in self.eval_margins if m is not None)
+        packed, metrics, self.margins, eval_m_out = self._round_fn(*args, eval_m)
+        ei = 0
+        for i in range(len(self.eval_margins)):
+            if self.eval_margins[i] is not None:
+                self.eval_margins[i] = eval_m_out[ei]
+                ei += 1
         packed_np = np.asarray(packed)  # ONE transfer for K rounds
-        return [unpack_tree(packed_np[j]) for j in range(packed_np.shape[0])]
+        metrics_np = np.asarray(metrics) if self.device_metric_fns else None
+        return (
+            [unpack_tree(packed_np[j]) for j in range(packed_np.shape[0])],
+            metrics_np,
+        )
 
     # ----------------------------------------------------------------- eval
     def _to_host(self, arr, n_real):
@@ -654,8 +748,16 @@ def train(
             config, forest, dtrain, list(evals), feval, callbacks, num_boost_round
         )
 
-    session = _TrainingSession(config, dtrain, list(evals), forest, mesh=mesh)
-    metric_names = _eval_metric_names(config, session.objective)
+    metric_names = _eval_metric_names(config, forest.objective())
+    session = _TrainingSession(
+        config,
+        dtrain,
+        list(evals),
+        forest,
+        mesh=mesh,
+        metric_names=metric_names,
+        has_feval=feval is not None,
+    )
 
     for cb in callbacks:
         if hasattr(cb, "before_training"):
@@ -686,15 +788,24 @@ def train(
     rnd = start_round
     stop = False
     while rnd < end_round and not stop:
-        for tree_np in session.run_rounds():
+        trees_batch, batch_metrics = session.run_rounds()
+        for j, tree_np in enumerate(trees_batch):
             if rnd >= end_round:
                 break  # trees past the requested count are discarded
             trees, info = _trees_for_round(tree_np)
             forest.append_round(trees, info)
 
-            results = (
-                session.evaluate(metric_names, feval=feval) if session.eval_sets else []
-            )
+            if batch_metrics is not None:
+                # device-computed per-round metrics: [K, n_sets, n_metrics]
+                results = [
+                    (name, metric_name, float(batch_metrics[j, si, i]))
+                    for si, (name, _dm, _b) in enumerate(session.eval_sets)
+                    for i, metric_name in enumerate(session.device_metric_names)
+                ]
+            elif session.eval_sets:
+                results = session.evaluate(metric_names, feval=feval)
+            else:
+                results = []
             for data_name, metric_name, value in results:
                 evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
 

@@ -1,0 +1,114 @@
+"""Device-side (jnp) eval metrics for batched-round dispatches.
+
+When every watched metric is computable on device and all eval sets share the
+training margins (the default SageMaker watchlist is just "train"), boosting
+rounds batch K-at-a-time (`_rounds_per_dispatch`) and the per-round metric
+scalars come back as one [K, n_metrics] array — preserving the per-round HPO
+stdout contract without per-round host round-trips.
+
+Weighted formulations throughout: padding rows carry weight 0, so they drop
+out of every metric automatically.
+"""
+
+import jax.numpy as jnp
+
+_EPS = 1e-15
+
+
+def _sigmoid(m):
+    return 1.0 / (1.0 + jnp.exp(-m))
+
+
+def _softmax(m):
+    e = jnp.exp(m - jnp.max(m, axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _weighted_mean(values, w):
+    return jnp.sum(values * w) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def _prob_transform(objective_name, margins):
+    if objective_name in ("reg:logistic", "binary:logistic"):
+        return _sigmoid(margins)
+    if objective_name in ("count:poisson", "reg:gamma", "reg:tweedie", "survival:aft", "survival:cox"):
+        return jnp.exp(margins)
+    return margins
+
+
+def make_device_metric(name, objective_name, num_group=1, params=None):
+    """-> fn(margins, labels, weights) -> scalar, or None if unsupported."""
+    params = params or {}
+    base, _, suffix = name.partition("@")
+
+    if num_group > 1:
+        if base == "merror":
+            def merror(m, y, w):
+                pred = jnp.argmax(m, axis=1)
+                return _weighted_mean((pred != y.astype(jnp.int32)).astype(jnp.float32), w)
+
+            return merror
+        if base == "mlogloss":
+            def mlogloss(m, y, w):
+                p = _softmax(m)
+                picked = jnp.take_along_axis(
+                    p, y.astype(jnp.int32)[:, None], axis=1
+                )[:, 0]
+                return _weighted_mean(-jnp.log(jnp.clip(picked, _EPS, 1.0)), w)
+
+            return mlogloss
+        return None
+
+    def with_pred(fn):
+        def wrapped(m, y, w):
+            return fn(_prob_transform(objective_name, m), y, w)
+
+        return wrapped
+
+    if base == "rmse":
+        return with_pred(lambda p, y, w: jnp.sqrt(_weighted_mean((p - y) ** 2, w)))
+    if base == "mse":
+        return with_pred(lambda p, y, w: _weighted_mean((p - y) ** 2, w))
+    if base == "mae":
+        return with_pred(lambda p, y, w: _weighted_mean(jnp.abs(p - y), w))
+    if base == "mape":
+        return with_pred(
+            lambda p, y, w: _weighted_mean(
+                jnp.abs((y - p) / jnp.maximum(jnp.abs(y), _EPS)), w
+            )
+        )
+    if base == "rmsle":
+        return with_pred(
+            lambda p, y, w: jnp.sqrt(
+                _weighted_mean((jnp.log1p(jnp.maximum(p, 0.0)) - jnp.log1p(y)) ** 2, w)
+            )
+        )
+    if base == "logloss":
+        def logloss(p, y, w):
+            p = jnp.clip(p, _EPS, 1 - _EPS)
+            return _weighted_mean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
+
+        return with_pred(logloss)
+    if base == "error":
+        threshold = float(suffix) if suffix else 0.5
+
+        def error(p, y, w):
+            return _weighted_mean(((p > threshold).astype(jnp.float32) != y).astype(jnp.float32), w)
+
+        return with_pred(error)
+    if base == "poisson-nloglik":
+        def poisson(p, y, w):
+            from jax.scipy.special import gammaln
+
+            p = jnp.maximum(p, _EPS)
+            return _weighted_mean(p - y * jnp.log(p) + gammaln(y + 1.0), w)
+
+        return with_pred(poisson)
+    return None
+
+
+def all_supported(names, objective_name, num_group, params=None):
+    fns = [make_device_metric(n, objective_name, num_group, params) for n in names]
+    if any(f is None for f in fns):
+        return None
+    return fns

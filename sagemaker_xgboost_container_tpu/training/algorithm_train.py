@@ -273,6 +273,21 @@ def train_job(
     if val_dmatrix:
         logger.info("Validation matrix has %d rows", val_dmatrix.num_row)
 
+    # Default to batching several boosting rounds per device dispatch when no
+    # per-round host artifact is required (checkpoint files / intermediate
+    # model saves must land every round for spot safety). The booster itself
+    # falls back to K=1 whenever per-round metrics can't ride back from the
+    # device (validation sets with separate margins, feval, AUC-style
+    # metrics). Explicit _rounds_per_dispatch always wins.
+    if (
+        not checkpoint_dir
+        and save_model_on_termination != "true"
+        and "_rounds_per_dispatch" not in train_cfg
+    ):
+        train_cfg["_rounds_per_dispatch"] = int(
+            os.environ.get("SM_ROUNDS_PER_DISPATCH_DEFAULT", "8")
+        )
+
     try:
         kfold = train_cfg.pop("_kfold", None)
         watchlist = [(train_dmatrix, "train")]

@@ -115,3 +115,110 @@ def test_logging_config():
 
     logger = setup_main_logger("x")
     logger.info("hello")
+
+
+def test_batched_rounds_emit_device_metrics():
+    """K>1 batching now works WITH a train watchlist: per-round metrics come
+    back from the device and the stdout contract holds."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(500, 4).astype(np.float32)
+    y = (X[:, 0] * 5).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    log = {}
+
+    class Recorder:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update({k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()})
+            return False
+
+    batched = train(
+        {"max_depth": 3, "seed": 2, "_rounds_per_dispatch": 4, "eval_metric": "rmse"},
+        dtrain,
+        num_boost_round=8,
+        evals=[(dtrain, "train")],
+        callbacks=[Recorder()],
+    )
+    assert len(log["train"]["rmse"]) == 8
+    # device metrics match host-computed metrics from an unbatched run
+    log2 = {}
+
+    class Recorder2:
+        def after_iteration(self, model, epoch, evals_log):
+            log2.update({k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()})
+            return False
+
+    train(
+        {"max_depth": 3, "seed": 2, "eval_metric": "rmse"},
+        dtrain,
+        num_boost_round=8,
+        evals=[(dtrain, "train")],
+        callbacks=[Recorder2()],
+    )
+    np.testing.assert_allclose(
+        log["train"]["rmse"], log2["train"]["rmse"], rtol=1e-4, atol=1e-5
+    )
+    assert batched.num_boosted_rounds == 8
+
+
+def test_batched_rounds_fall_back_for_auc():
+    rng = np.random.RandomState(4)
+    X = rng.rand(300, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    log = {}
+
+    class Recorder:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update(evals_log)
+            return False
+
+    train(
+        {
+            "objective": "binary:logistic",
+            "max_depth": 3,
+            "_rounds_per_dispatch": 4,
+            "eval_metric": "auc",
+        },
+        dtrain,
+        num_boost_round=4,
+        evals=[(dtrain, "train")],
+        callbacks=[Recorder()],
+    )
+    assert len(log["train"]["auc"]) == 4  # host fallback still per-round
+
+
+def test_batched_rounds_with_validation_set_device_metrics():
+    rng = np.random.RandomState(5)
+    X = rng.rand(700, 4).astype(np.float32)
+    y = (X[:, 0] * 5 + X[:, 1]).astype(np.float32)
+    dtrain = DataMatrix(X[:500], labels=y[:500])
+    dval = DataMatrix(X[500:], labels=y[500:])
+
+    def run(params):
+        log = {}
+
+        class Rec:
+            def after_iteration(self, model, epoch, evals_log):
+                log.update(
+                    {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+                )
+                return False
+
+        train(
+            params,
+            dtrain,
+            num_boost_round=6,
+            evals=[(dtrain, "train"), (dval, "validation")],
+            callbacks=[Rec()],
+        )
+        return log
+
+    batched = run({"max_depth": 3, "seed": 6, "_rounds_per_dispatch": 3, "eval_metric": "rmse"})
+    plain = run({"max_depth": 3, "seed": 6, "eval_metric": "rmse"})
+    assert len(batched["validation"]["rmse"]) == 6
+    np.testing.assert_allclose(
+        batched["validation"]["rmse"], plain["validation"]["rmse"], rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        batched["train"]["rmse"], plain["train"]["rmse"], rtol=1e-4, atol=1e-5
+    )

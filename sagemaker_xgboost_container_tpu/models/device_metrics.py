@@ -96,6 +96,33 @@ def make_device_metric(name, objective_name, num_group=1, params=None):
             return _weighted_mean(((p > threshold).astype(jnp.float32) != y).astype(jnp.float32), w)
 
         return with_pred(error)
+    if base == "auc":
+        def auc(p, y, w):
+            # weighted Mann-Whitney with tie midranks in cumulative-weight
+            # space (same formulation as eval_metrics.auc, static shapes:
+            # tie groups via neighbor-inequality cumsum + segment reductions)
+            n = p.shape[0]
+            order = jnp.argsort(p)
+            sp, sw = p[order], w[order]
+            spos = (y[order] > 0).astype(jnp.float32) * sw
+            sneg = (1.0 - (y[order] > 0).astype(jnp.float32)) * sw
+            new_group = jnp.concatenate(
+                [jnp.ones(1, jnp.int32), (sp[1:] != sp[:-1]).astype(jnp.int32)]
+            )
+            gid = jnp.cumsum(new_group) - 1
+            import jax as _jax
+
+            group_w = _jax.ops.segment_sum(sw, gid, num_segments=n)
+            cumw = jnp.cumsum(sw)
+            group_end = _jax.ops.segment_max(cumw, gid, num_segments=n)
+            midrank = group_end - group_w / 2.0
+            ranks = midrank[gid]
+            w_pos = jnp.sum(spos)
+            w_neg = jnp.sum(sneg)
+            u = jnp.sum(ranks * spos) - w_pos * w_pos / 2.0
+            return jnp.clip(u / jnp.maximum(w_pos * w_neg, _EPS), 0.0, 1.0)
+
+        return with_pred(auc)
     if base == "poisson-nloglik":
         def poisson(p, y, w):
             from jax.scipy.special import gammaln

@@ -1,0 +1,97 @@
+"""Device metrics == host metrics (weighted, with ties and zero-weight pads)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sagemaker_xgboost_container_tpu.models import eval_metrics
+from sagemaker_xgboost_container_tpu.models.device_metrics import make_device_metric
+
+
+def _data(seed=0, n=500, tie_frac=0.3):
+    rng = np.random.RandomState(seed)
+    margins = rng.randn(n).astype(np.float32)
+    # inject prediction ties
+    ties = rng.rand(n) < tie_frac
+    margins[ties] = np.round(margins[ties], 1)
+    labels = (rng.rand(n) < 0.4).astype(np.float32)
+    weights = rng.rand(n).astype(np.float32) + 0.1
+    # zero-weight padding tail
+    margins = np.concatenate([margins, rng.randn(16).astype(np.float32)])
+    labels = np.concatenate([labels, np.zeros(16, np.float32)])
+    weights = np.concatenate([weights, np.zeros(16, np.float32)])
+    return margins, labels, weights
+
+
+@pytest.mark.parametrize(
+    "name,objective",
+    [
+        ("rmse", "reg:squarederror"),
+        ("mae", "reg:squarederror"),
+        ("logloss", "binary:logistic"),
+        ("error", "binary:logistic"),
+        ("error@0.3", "binary:logistic"),
+        ("auc", "binary:logistic"),
+    ],
+)
+def test_device_matches_host(name, objective):
+    margins, labels, weights = _data()
+    fn = make_device_metric(name, objective)
+    assert fn is not None
+    got = float(fn(jnp.asarray(margins), jnp.asarray(labels), jnp.asarray(weights)))
+
+    n_real = len(margins) - 16
+    m, y, w = margins[:n_real], labels[:n_real], weights[:n_real]
+    if objective == "binary:logistic":
+        preds = 1.0 / (1.0 + np.exp(-m))
+    else:
+        preds = m
+    want = eval_metrics.evaluate(name, preds, y, w)
+    assert abs(got - want) < 1e-4, (name, got, want)
+
+
+def test_multiclass_device_metrics():
+    rng = np.random.RandomState(1)
+    n, C = 300, 4
+    margins = rng.randn(n, C).astype(np.float32)
+    labels = rng.randint(0, C, n).astype(np.float32)
+    weights = rng.rand(n).astype(np.float32) + 0.1
+    e = np.exp(margins - margins.max(axis=1, keepdims=True))
+    prob = e / e.sum(axis=1, keepdims=True)
+    for name in ("merror", "mlogloss"):
+        fn = make_device_metric(name, "multi:softprob", num_group=C)
+        got = float(fn(jnp.asarray(margins), jnp.asarray(labels), jnp.asarray(weights)))
+        want = eval_metrics.evaluate(name, None, labels, weights, prob_matrix=prob)
+        assert abs(got - want) < 1e-5, (name, got, want)
+
+
+def test_batched_auc_through_train():
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(2)
+    X = rng.rand(400, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+
+    def run(params):
+        log = {}
+
+        class Rec:
+            def after_iteration(self, model, epoch, evals_log):
+                log.update(
+                    {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+                )
+                return False
+
+        train(params, dtrain, num_boost_round=6, evals=[(dtrain, "train")], callbacks=[Rec()])
+        return log
+
+    batched = run(
+        {"objective": "binary:logistic", "max_depth": 3, "seed": 3,
+         "_rounds_per_dispatch": 3, "eval_metric": "auc"}
+    )
+    plain = run({"objective": "binary:logistic", "max_depth": 3, "seed": 3, "eval_metric": "auc"})
+    np.testing.assert_allclose(
+        batched["train"]["auc"], plain["train"]["auc"], rtol=1e-4, atol=1e-5
+    )

@@ -160,7 +160,7 @@ def test_batched_rounds_emit_device_metrics():
     assert batched.num_boosted_rounds == 8
 
 
-def test_batched_rounds_fall_back_for_auc():
+def test_batched_rounds_auc_metrics_still_per_round():
     rng = np.random.RandomState(4)
     X = rng.rand(300, 3).astype(np.float32)
     y = (X[:, 0] > 0.5).astype(np.float32)

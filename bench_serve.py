@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Serving latency benchmark: p50/p99 of POST /invocations.
+
+BASELINE.md's second metric ("p50 serve-predict latency"). Runs the real
+threaded WSGI server in-process against a trained abalone-sized model and
+measures end-to-end HTTP latency for single-row csv payloads, then a batch
+payload. Prints one JSON line (not the driver contract — bench.py is that;
+this is the measurement tool for serving work).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+N_REQUESTS = int(os.getenv("BENCH_SERVE_REQUESTS", "300"))
+
+
+def main():
+    import urllib.request
+    from wsgiref.simple_server import make_server
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.serving.app import ScoringService, make_app
+    from sagemaker_xgboost_container_tpu.serving.server import (
+        _QuietHandler,
+        _ThreadedWSGIServer,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(4000, 8).astype(np.float32)
+    y = (X @ rng.rand(8).astype(np.float32) * 10).astype(np.float32)
+    forest = train(
+        {"max_depth": 6, "objective": "reg:squarederror"}, DataMatrix(X, labels=y),
+        num_boost_round=100,
+    )
+    import tempfile
+
+    model_dir = tempfile.mkdtemp()
+    forest.save_model(os.path.join(model_dir, "xgboost-model"))
+
+    app = make_app(ScoringService(model_dir))
+    httpd = make_server(
+        "127.0.0.1", 0, app, server_class=_ThreadedWSGIServer, handler_class=_QuietHandler
+    )
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = "http://127.0.0.1:{}/invocations".format(port)
+
+    def post(body):
+        req = urllib.request.Request(
+            base, data=body, method="POST", headers={"Content-Type": "text/csv"}
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        return time.perf_counter() - t0
+
+    single = ",".join("%.4f" % v for v in X[0]).encode()
+    post(single)  # warm the jit cache
+    lat = sorted(post(single) for _ in range(N_REQUESTS))
+    batch = "\n".join(
+        ",".join("%.4f" % v for v in row) for row in X[:256]
+    ).encode()
+    post(batch)
+    blat = sorted(post(batch) for _ in range(50))
+    httpd.shutdown()
+    print(
+        json.dumps(
+            {
+                "metric": "serve /invocations latency (100-tree depth-6 model)",
+                "p50_single_row_ms": round(lat[len(lat) // 2] * 1000, 2),
+                "p99_single_row_ms": round(lat[int(len(lat) * 0.99) - 1] * 1000, 2),
+                "p50_batch256_ms": round(blat[len(blat) // 2] * 1000, 2),
+                "unit": "ms",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -203,3 +203,43 @@ def test_matrix_slice_and_concat():
     np.testing.assert_allclose(sl.labels, [0, 2, 4])
     cat = sl.concat(dm.slice([1, 3]))
     assert cat.num_row == 5
+
+
+def test_fixture_sweep_all_reference_data_dirs():
+    """Every remaining reference data fixture loads into a DataMatrix."""
+    cases = [
+        (FIXTURES + "/csv/multiple_files", "csv"),
+        (FIXTURES + "/csv/weighted_csv_files", "csv"),
+        (FIXTURES + "/recordio_protobuf/pb_files", "application/x-recordio-protobuf"),
+        (FIXTURES + "/recordio_protobuf/sparse", "application/x-recordio-protobuf"),
+        (FIXTURES + "/libsvm/libsvm_files", "libsvm"),
+    ]
+    for path, ct in cases:
+        dm = readers.get_data_matrix(path, ct)
+        assert dm is not None and dm.num_row > 0, path
+
+
+def test_abalone_binary_and_multiclass_train():
+    from sagemaker_xgboost_container_tpu.models import train
+
+    dm_bin = readers.get_data_matrix(
+        "/root/reference/test/resources/abalone-binary/data/train", "libsvm"
+    )
+    assert set(np.unique(dm_bin.labels)) <= {0.0, 1.0}
+    forest = train(
+        {"objective": "binary:logistic", "max_depth": 3}, dm_bin, num_boost_round=5
+    )
+    p = forest.predict(dm_bin.features)
+    assert ((p > 0.5) == dm_bin.labels).mean() > 0.7
+
+    dm_multi = readers.get_data_matrix(
+        "/root/reference/test/resources/abalone-multiclass/data/train", "libsvm"
+    )
+    n_class = int(dm_multi.labels.max()) + 1
+    forest = train(
+        {"objective": "multi:softprob", "num_class": n_class, "max_depth": 3},
+        dm_multi,
+        num_boost_round=4,
+    )
+    prob = forest.predict(dm_multi.features)
+    assert prob.shape == (dm_multi.num_row, n_class)

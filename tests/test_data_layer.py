@@ -214,8 +214,8 @@ def test_fixture_sweep_all_reference_data_dirs():
         (FIXTURES + "/recordio_protobuf/sparse", "application/x-recordio-protobuf"),
         (FIXTURES + "/libsvm/libsvm_files", "libsvm"),
     ]
-    for path, ct in cases:
-        dm = readers.get_data_matrix(path, ct)
+    for path, content_type in cases:
+        dm = readers.get_data_matrix(path, content_type)
         assert dm is not None and dm.num_row > 0, path
 
 

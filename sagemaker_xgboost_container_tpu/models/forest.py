@@ -305,6 +305,12 @@ class Forest:
 
     @staticmethod
     def _tree_from_json(blob):
+        if blob.get("categories_nodes"):
+            raise exc.UserError(
+                "This model uses categorical splits (xgboost enable_categorical), "
+                "which the TPU predictor does not support yet; re-train with "
+                "one-hot/ordinal encoded features."
+            )
         left = np.asarray(blob["left_children"], np.int32)
         is_leaf = left < 0
         cond = np.asarray(blob["split_conditions"], np.float32)

@@ -47,6 +47,7 @@ def build_tree(
     rng=None,
     colsample_bylevel=1.0,
     interaction_sets=None,
+    feature_axis_name=None,
 ):
     """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
 
@@ -54,6 +55,13 @@ def build_tree(
       feature, bin (i32), default_left (bool), is_leaf (bool),
       leaf_value (f32, eta already applied), base_weight (f32, pre-eta),
       gain (f32), sum_hess (f32).
+
+    feature_axis_name: optional second mesh axis carrying a *column* shard
+    (the reference's vestigial dsplit=col, done properly): ``bins`` holds only
+    this shard's feature columns; candidate splits combine across the axis by
+    max-gain, and row routing decisions (which need the winning feature's
+    bins) are computed by the owning shard and psum-broadcast. Emitted
+    feature ids are global.
     """
     n, d = bins.shape
     max_nodes = max_nodes_for_depth(max_depth)
@@ -78,8 +86,16 @@ def build_tree(
     # keeps alive only the sets containing f (xgboost semantics).
     alive_sets = None
     if interaction_sets is not None:
+        if feature_axis_name is not None:
+            raise NotImplementedError(
+                "interaction_constraints with feature-axis sharding is unsupported"
+            )
         num_sets = interaction_sets.shape[0]
         alive_sets = jnp.ones((1, num_sets), jnp.bool_)
+
+    feat_shard = (
+        jax.lax.axis_index(feature_axis_name) if feature_axis_name is not None else None
+    )
 
     for level in range(max_depth + 1):
         first = 2**level - 1
@@ -114,6 +130,32 @@ def build_tree(
             feature_mask=level_mask,
             monotone=monotone,
         )
+        if feature_axis_name is not None:
+            # combine candidates across the column shards: winner = max gain,
+            # ties broken toward the lowest global feature id; every shard
+            # ends with identical (global-feature) split decisions
+            global_feat = splits["feature"] + feat_shard * d
+            gain = splits["gain"]
+            best_gain = jax.lax.pmax(gain, feature_axis_name)
+            is_tied_winner = gain == best_gain
+            cand = jnp.where(is_tied_winner, global_feat, jnp.int32(2**30))
+            win_feat = jax.lax.pmin(cand, feature_axis_name)
+            i_own = is_tied_winner & (global_feat == win_feat)
+
+            def _combine(x):
+                return jax.lax.psum(
+                    jnp.where(i_own, x, jnp.zeros_like(x)), feature_axis_name
+                )
+
+            splits = {
+                "gain": best_gain,
+                "feature": _combine(global_feat),
+                "bin": _combine(splits["bin"]),
+                "default_left": _combine(splits["default_left"].astype(jnp.int32)) > 0,
+                "g_total": splits["g_total"],   # identical on every shard
+                "h_total": splits["h_total"],
+            }
+
         g_tot, h_tot = splits["g_total"], splits["h_total"]
         weight = leaf_weight(
             g_tot, h_tot, reg_lambda=reg_lambda, alpha=alpha, max_delta_step=max_delta_step
@@ -147,11 +189,29 @@ def build_tree(
 
         split_feat = splits["feature"][local_safe]
         split_bin = splits["bin"][local_safe]
-        row_bin = jnp.take_along_axis(bins, split_feat[:, None], axis=1)[:, 0]
-        is_missing = row_bin == (num_bins - 1)
-        go_right = jnp.where(
-            is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
-        )
+        if feature_axis_name is None:
+            row_bin = jnp.take_along_axis(bins, split_feat[:, None], axis=1)[:, 0]
+            is_missing = row_bin == (num_bins - 1)
+            go_right = jnp.where(
+                is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
+            )
+        else:
+            # only the shard owning a node's split feature can decide its
+            # rows; decisions psum-broadcast along the feature axis
+            owner = (split_feat // d) == feat_shard
+            local_idx = jnp.clip(split_feat - feat_shard * d, 0, d - 1)
+            row_bin = jnp.take_along_axis(bins, local_idx[:, None], axis=1)[:, 0]
+            is_missing = row_bin == (num_bins - 1)
+            decision = jnp.where(
+                is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
+            )
+            go_right = (
+                jax.lax.psum(
+                    jnp.where(owner, decision, False).astype(jnp.int32),
+                    feature_axis_name,
+                )
+                > 0
+            )
         child = node_of_row * 2 + 1 + go_right.astype(jnp.int32)
         node_of_row = jnp.where(
             row_leafed, -1, jnp.where(at_level, child, node_of_row)

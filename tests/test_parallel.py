@@ -282,3 +282,70 @@ def test_ranking_group_chunking_equivalence():
     g2, h2 = lambdarank_grad_hess(margins, labels, weights, idx, "ndcg", group_chunk=999)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.multichip
+def test_2d_mesh_feature_axis_tree_build():
+    """(data x feature) 2D mesh: column-sharded histogram build + split
+    combination produces the identical tree as a single device (the
+    reference's dsplit=col, done as SPMD)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from sagemaker_xgboost_container_tpu.data.binning import (
+        apply_cut_points,
+        compute_cut_points,
+    )
+    from sagemaker_xgboost_container_tpu.ops.tree_build import build_tree, pack_tree
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    rng = np.random.RandomState(0)
+    n, d, max_bin = 512, 8, 32
+    X = rng.rand(n, d).astype(np.float32)
+    y = (3 * X[:, 5] + np.sin(6 * X[:, 2]) + X[:, 0] * X[:, 1]).astype(np.float32)
+    grad = (y - y.mean()).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    cuts = compute_cut_points(X, None, max_bin)
+    bins = apply_cut_points(X, cuts, max_bin).astype(np.int32)
+    num_cuts = np.asarray([len(c) for c in cuts], np.int32)
+    B = max_bin + 1
+
+    kwargs = dict(max_depth=3, num_bins=B, reg_lambda=1.0, eta=0.3)
+
+    ref_tree, ref_out = build_tree(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(num_cuts), **kwargs
+    )
+
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, axis_names=("data", "feature"))
+
+    def build(b, g, h, nc):
+        tree, row_out = build_tree(
+            b, g, h, nc, axis_name="data", feature_axis_name="feature", **kwargs
+        )
+        return pack_tree(tree), row_out
+
+    mapped = shard_map(
+        build,
+        mesh=mesh,
+        in_specs=(P("data", "feature"), P("data"), P("data"), P("feature")),
+        out_specs=(P(), P("data")),
+        check_vma=False,
+    )
+    packed, row_out = mapped(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(num_cuts)
+    )
+    from sagemaker_xgboost_container_tpu.ops.tree_build import unpack_tree
+
+    got = unpack_tree(np.asarray(packed))
+    want = {k: np.asarray(v) for k, v in ref_tree.items()}
+    np.testing.assert_array_equal(got["feature"], want["feature"])
+    np.testing.assert_array_equal(got["bin"], want["bin"])
+    np.testing.assert_array_equal(got["is_leaf"], want["is_leaf"])
+    np.testing.assert_allclose(got["leaf_value"], want["leaf_value"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(row_out), np.asarray(ref_out), rtol=1e-5, atol=1e-6)

@@ -412,3 +412,50 @@ class TestBatcher:
             svc.model, svc.model_format, dtest, "text/libsvm", svc.objective
         )
         np.testing.assert_allclose(np.asarray(batched), np.asarray(direct), rtol=1e-6)
+
+
+class TestEnsembleAndBatchMode:
+    def test_ensemble_average(self, tmp_path):
+        rng = np.random.RandomState(0)
+        X = rng.rand(200, 3).astype(np.float32)
+        y = (X[:, 0] * 4).astype(np.float32)
+        m1 = train({"max_depth": 3, "seed": 1}, DataMatrix(X, labels=y), num_boost_round=3)
+        m2 = train({"max_depth": 3, "seed": 2, "subsample": 0.7}, DataMatrix(X, labels=y), num_boost_round=3)
+        m1.save_model(str(tmp_path / "xgboost-model-0"))
+        m2.save_model(str(tmp_path / "xgboost-model-1"))
+
+        model, fmt = serve_utils.get_loaded_booster(str(tmp_path), ensemble=True)
+        assert isinstance(model, list) and len(model) == 2
+        dtest = DataMatrix(X[:5])
+        preds = serve_utils.predict(model, fmt, dtest, "text/csv", "reg:squarederror")
+        expect = (m1.predict(X[:5]) + m2.predict(X[:5])) / 2.0
+        np.testing.assert_allclose(np.asarray(preds), expect, rtol=1e-5)
+
+    def test_ensemble_disabled_env(self, tmp_path, monkeypatch):
+        rng = np.random.RandomState(3)
+        X = rng.rand(100, 2).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        m = train({"max_depth": 2}, DataMatrix(X, labels=y), num_boost_round=2)
+        m.save_model(str(tmp_path / "xgboost-model-0"))
+        m.save_model(str(tmp_path / "xgboost-model-1"))
+        monkeypatch.setenv("SAGEMAKER_INFERENCE_ENSEMBLE", "false")
+        svc = ScoringService(str(tmp_path))
+        svc.load_model()
+        assert not isinstance(svc.model, list)
+
+    def test_sagemaker_batch_output(self, abalone_model_dir, monkeypatch):
+        monkeypatch.setenv("SAGEMAKER_BATCH", "true")
+        app = make_app(ScoringService(abalone_model_dir))
+        base, httpd = _serve(app)
+        try:
+            status, body, _ = _request(
+                base + "/invocations",
+                method="POST",
+                data=LIBSVM_PAYLOAD,
+                headers={"Content-Type": "text/libsvm"},
+            )
+            assert status == 200
+            # batch transform responses are newline-terminated
+            assert body.endswith(b"\n")
+        finally:
+            httpd.shutdown()

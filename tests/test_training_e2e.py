@@ -290,3 +290,48 @@ def test_two_host_membership_dataless_host_exits(tmp_path):
     # exactly the data-holding host saved a model
     assert (dirs["127.0.0.1"] / "xgboost-model").exists()
     assert not (dirs["localhost"] / "xgboost-model").exists()
+
+
+@pytest.mark.e2e
+def test_script_mode_training(tmp_path):
+    """Reference script-mode path (test_boston.py analog): the user's training
+    script runs as a subprocess with SM_HPS and saves its own model."""
+    code_dir = tmp_path / "code"
+    code_dir.mkdir()
+    (code_dir / "my_train.py").write_text(
+        "import argparse, json, os, sys\n"
+        "sys.path.insert(0, os.environ['FRAMEWORK_REPO'])\n"
+        "import numpy as np\n"
+        "from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix\n"
+        "from sagemaker_xgboost_container_tpu.models import train\n"
+        "\n"
+        "parser = argparse.ArgumentParser()\n"
+        "parser.add_argument('--num_round', type=int, default=3)\n"
+        "parser.add_argument('--max_depth', type=int, default=3)\n"
+        "args, _ = parser.parse_known_args()\n"
+        "hps = json.loads(os.environ['SM_HPS'])\n"
+        "assert hps['num_round'] == '4', hps\n"
+        "rng = np.random.RandomState(0)\n"
+        "X = rng.rand(200, 3).astype(np.float32)\n"
+        "y = (X[:, 0] * 5).astype(np.float32)\n"
+        "forest = train({'max_depth': args.max_depth}, DataMatrix(X, labels=y),\n"
+        "               num_boost_round=args.num_round)\n"
+        "forest.save_model(os.path.join(os.environ['SM_MODEL_DIR'], 'xgboost-model'))\n"
+        "print('USER_SCRIPT_DONE rounds=', forest.num_boosted_rounds)\n"
+    )
+    env, model_dir, _ = _sm_env(
+        tmp_path,
+        {
+            "num_round": "4",
+            "max_depth": "3",
+            "sagemaker_program": "my_train.py",
+            "sagemaker_submit_directory": str(code_dir),
+        },
+        {"train": LIBSVM_CHANNELS["train"]},
+        ABALONE + "/train",
+    )
+    env["FRAMEWORK_REPO"] = REPO
+    result = _run_train(env)
+    assert result.returncode == 0, result.stdout[-2000:] + result.stderr[-2000:]
+    assert "USER_SCRIPT_DONE" in result.stdout
+    assert (model_dir / "xgboost-model").exists()

@@ -12,8 +12,6 @@ missing); the scan considers splits at bins 0..B-3 masked by each feature's
 true cut count.
 """
 
-from functools import partial
-
 import jax.numpy as jnp
 
 _EPS = 1e-6  # xgboost kRtEps: minimum loss change to accept a split

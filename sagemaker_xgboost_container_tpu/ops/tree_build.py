@@ -13,8 +13,6 @@ finalized rows hold -1 and accumulate their leaf value into ``row_out``, so
 the booster updates margins without re-predicting the train set.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

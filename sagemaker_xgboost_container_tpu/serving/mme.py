@@ -23,9 +23,8 @@ import logging
 import os
 import threading
 
-from .. import constants
 from . import serve_utils
-from .app import PARSED_MAX_CONTENT_LENGTH, _read_body, _response, parse_accept
+from .app import _read_body, _response, parse_accept
 
 logger = logging.getLogger(__name__)
 

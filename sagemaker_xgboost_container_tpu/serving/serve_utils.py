@@ -36,7 +36,7 @@ from ..constants import (
     REG_SQUAREDERR,
     REG_TWEEDIE,
 )
-from ..data.content_types import CSV, LIBSVM, PARQUET, RECORDIO_PROTOBUF, get_content_type
+from ..data.content_types import CSV, LIBSVM, RECORDIO_PROTOBUF, get_content_type
 from ..data.recordio import record_pb2, _frame
 from ..models.compat import load_model_any_format
 from ..toolkit import exceptions as exc

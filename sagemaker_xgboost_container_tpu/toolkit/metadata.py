@@ -72,7 +72,6 @@ def generate_algorithm_spec(image_uri):
     from ..algorithm import channels as cv
     from ..algorithm import hyperparameters as hpv
     from ..algorithm import metrics as metrics_mod
-    from ..data.content_types import VALID_CONTENT_TYPES
 
     metrics = metrics_mod.initialize()
     hps = hpv.initialize(metrics)

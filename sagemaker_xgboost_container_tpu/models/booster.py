@@ -202,15 +202,38 @@ class _TrainingSession:
         self.num_group = self.objective.num_output_group
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+        # optional second mesh axis: column sharding for wide data
+        self.has_feature_axis = mesh is not None and "feature" in mesh.axis_names
+        self.n_feature_shards = (
+            int(mesh.shape["feature"]) if self.has_feature_axis else 1
+        )
+        self.n_data_shards = (
+            int(mesh.shape["data"]) if mesh is not None else 1
+        )
         # multi-host: every process holds its own row shard; device arrays are
         # assembled into global arrays over the whole mesh
         self.is_multiprocess = mesh is not None and jax.process_count() > 1
+        if self.is_multiprocess and self.has_feature_axis:
+            raise exc.UserError(
+                "feature-axis sharding across processes is not supported yet"
+            )
+        if self.has_feature_axis and (
+            config.colsample_bytree < 1.0
+            or config.colsample_bylevel < 1.0
+            or config.monotone_constraints
+            or config.interaction_constraints
+            or config.grow_policy == "lossguide"
+        ):
+            raise exc.UserError(
+                "feature-axis sharding does not support colsample/monotone/"
+                "interaction constraints or lossguide growth yet"
+            )
         if self.is_multiprocess:
             # local rows pad to a multiple of *local* devices; the global
             # array is the concatenation over processes
             self.pad_unit = max(1, len(mesh.local_devices))
         else:
-            self.pad_unit = self.n_shards
+            self.pad_unit = self.n_data_shards
 
         labels = dtrain.labels
         self.objective.validate_labels(labels)
@@ -248,7 +271,6 @@ class _TrainingSession:
 
         self.train_binned = bin_matrix(dtrain, config.max_bin, cut_points=shared_cuts)
         self.cuts = self.train_binned.cut_points
-        self.num_cuts = jnp.asarray(np.array([len(c) for c in self.cuts], np.int32))
         self.eval_sets = []
         for dm, name in evals:
             binned = (
@@ -261,18 +283,49 @@ class _TrainingSession:
         self.n = dtrain.num_row
         n_pad = -(-self.n // self.pad_unit) * self.pad_unit
 
-        def _put(local_np, row_spec):
-            """Local host array -> device array (global across processes)."""
-            if not self.is_multiprocess:
+        # column padding: features pad to a multiple of the feature shards
+        # with always-missing columns (zero cuts -> never split on)
+        d_real = self.train_binned.num_col
+        d_pad = -(-d_real // self.n_feature_shards) * self.n_feature_shards
+        self.d_pad = d_pad
+        if d_pad != d_real:
+            self.cuts = list(self.cuts) + [
+                np.zeros(0, np.float32) for _ in range(d_pad - d_real)
+            ]
+        num_cuts_np = np.array([len(c) for c in self.cuts], np.int32)
+
+        def _put(local_np, spec):
+            """Local host array -> placed device array (global across procs)."""
+            if self.mesh is None:
                 return jnp.asarray(local_np)
             from jax.sharding import NamedSharding
 
-            sharding = NamedSharding(self.mesh, row_spec)
-            return jax.make_array_from_process_local_data(sharding, local_np)
+            sharding = NamedSharding(self.mesh, spec)
+            if self.is_multiprocess:
+                return jax.make_array_from_process_local_data(sharding, local_np)
+            return jax.device_put(local_np, sharding)
 
+        self.bins_spec = (
+            P("data", "feature") if self.has_feature_axis else P("data", None)
+        )
+        self.feat_spec = P("feature") if self.has_feature_axis else P()
         margin_spec = P("data") if self.num_group == 1 else P("data", None)
+
         bins_np = _pad_rows(self.train_binned.bins, n_pad, self.train_binned.max_bin)
-        self.bins = _put(bins_np, P("data", None))
+        if d_pad != d_real:
+            bins_np = np.concatenate(
+                [
+                    bins_np,
+                    np.full(
+                        (bins_np.shape[0], d_pad - d_real),
+                        self.train_binned.max_bin,
+                        bins_np.dtype,
+                    ),
+                ],
+                axis=1,
+            )
+        self.num_cuts = _put(num_cuts_np, self.feat_spec)
+        self.bins = _put(bins_np, self.bins_spec)
         self.labels = _put(_pad_rows(labels, n_pad, 0.0), P("data"))
         self.weights = _put(_pad_rows(dtrain.get_weight(), n_pad, 0.0), P("data"))
         self.groups = dtrain.groups
@@ -345,7 +398,7 @@ class _TrainingSession:
             else:
                 self.device_metric_names = list(metric_names)
 
-        monotone = np.zeros(dtrain.num_col, np.int32)
+        monotone = np.zeros(self.d_pad, np.int32)
         if config.monotone_constraints:
             vals = np.asarray(config.monotone_constraints, np.int32)
             monotone[: len(vals)] = vals
@@ -373,6 +426,7 @@ class _TrainingSession:
         cfg = self.config
         num_bins = self.train_binned.num_bins
         axis_name = "data" if self.mesh is not None else None
+        feature_axis = "feature" if self.has_feature_axis else None
         interaction_sets = None
         if cfg.interaction_constraints:
             d_cols = self.train_binned.num_col
@@ -398,6 +452,7 @@ class _TrainingSession:
             colsample_bylevel=cfg.colsample_bylevel,
             axis_name=axis_name,
             interaction_sets=interaction_sets,
+            feature_axis_name=feature_axis,
         )
         if cfg.grow_policy == "lossguide":
             from ..ops.lossguide import build_tree_lossguide
@@ -538,23 +593,35 @@ class _TrainingSession:
             return jax.jit(fn, donate_argnums=(1, 8))
 
         margin_spec = P("data") if num_group == 1 else P("data", None)
+        base_specs = (
+            self.bins_spec,    # bins
+            margin_spec,       # margins
+            P("data"),         # labels
+            P("data"),         # weights
+            self.feat_spec,    # num_cuts
+            P(),               # rng
+            self.feat_spec,    # feature_mask
+            self.feat_spec,    # monotone
+        )
+        if K == 1:
+            in_specs = base_specs
+            out_specs = (P(), margin_spec)
+            donate = (1,)
+        else:
+            eval_specs = tuple(
+                margin_spec for m in self.eval_margins if m is not None
+            )
+            in_specs = base_specs + (eval_specs,)
+            out_specs = (P(), P(), margin_spec, eval_specs)
+            donate = (1, 8)
         mapped = shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(
-                P("data", None),   # bins
-                margin_spec,       # margins
-                P("data"),         # labels
-                P("data"),         # weights
-                P(),               # num_cuts
-                P(),               # rng
-                P(),               # feature_mask
-                P(),               # monotone
-            ),
-            out_specs=(P(), margin_spec),
+            in_specs=in_specs,
+            out_specs=out_specs,
             check_vma=False,
         )
-        return jax.jit(mapped, donate_argnums=(1,))
+        return jax.jit(mapped, donate_argnums=donate)
 
     def _make_apply_fn(self):
         cfg = self.config

@@ -46,6 +46,7 @@ def build_tree_lossguide(
     rng=None,
     colsample_bylevel=1.0,
     interaction_sets=None,
+    feature_axis_name=None,
 ):
     """Grow one leaf-wise tree. Returns (tree arrays dict, row_out [n]).
 
@@ -55,6 +56,10 @@ def build_tree_lossguide(
     if interaction_sets is not None:
         raise NotImplementedError(
             "interaction_constraints with grow_policy=lossguide is not supported yet"
+        )
+    if feature_axis_name is not None:
+        raise NotImplementedError(
+            "feature-axis sharding with grow_policy=lossguide is not supported yet"
         )
     n, d = bins.shape
     bins = bins.astype(jnp.int32)

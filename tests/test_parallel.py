@@ -349,3 +349,41 @@ def test_2d_mesh_feature_axis_tree_build():
     np.testing.assert_array_equal(got["is_leaf"], want["is_leaf"])
     np.testing.assert_allclose(got["leaf_value"], want["leaf_value"], rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(row_out), np.asarray(ref_out), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.multichip
+def test_train_api_2d_mesh():
+    """train() on a (data x feature) 2D mesh matches single-device output,
+    including column padding when d doesn't divide the feature shards."""
+    from jax.sharding import Mesh as JMesh
+
+    X, y = _friedman(512)  # d = 5, feature shards = 2 -> pads to 6
+    dtrain = DataMatrix(X, labels=y)
+    params = {"max_depth": 4, "eta": 0.3, "seed": 11}
+    single = train(params, dtrain, num_boost_round=5)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = JMesh(devices, axis_names=("data", "feature"))
+    sharded = train(params, dtrain, num_boost_round=5, mesh=mesh2d)
+    np.testing.assert_allclose(
+        single.predict(X), sharded.predict(X), rtol=1e-4, atol=1e-4
+    )
+
+
+@pytest.mark.multichip
+def test_mesh_k_batching_no_evals(mesh8):
+    """mesh + _rounds_per_dispatch>1 without eval sets (spec-structure path)."""
+    X, y = _friedman(1024)
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {"max_depth": 3, "eta": 0.3, "seed": 12, "_rounds_per_dispatch": 3},
+        dtrain,
+        num_boost_round=6,
+        mesh=mesh8,
+    )
+    assert forest.num_boosted_rounds == 6
+    single = train(
+        {"max_depth": 3, "eta": 0.3, "seed": 12}, dtrain, num_boost_round=6
+    )
+    np.testing.assert_allclose(
+        forest.predict(X), single.predict(X), rtol=1e-4, atol=1e-4
+    )

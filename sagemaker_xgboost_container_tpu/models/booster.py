@@ -770,14 +770,28 @@ def train(
     callbacks = list(callbacks or [])
 
     if config.booster == "gblinear":
-        if xgb_model is not None:
-            raise exc.UserError(
-                "Continuing gblinear training from a checkpoint is not supported yet."
-            )
-        from .gblinear import train_linear
+        from .gblinear import LinearModel, train_linear
 
+        initial = None
+        if xgb_model is not None:
+            if isinstance(xgb_model, LinearModel):
+                initial = xgb_model
+            else:
+                from .compat import load_model_any_format
+
+                initial, _fmt = load_model_any_format(xgb_model)
+                if not isinstance(initial, LinearModel):
+                    raise exc.UserError(
+                        "Checkpoint {} is not a gblinear model".format(xgb_model)
+                    )
         return train_linear(
-            config, dtrain, num_boost_round, evals=evals, feval=feval, callbacks=callbacks
+            config,
+            dtrain,
+            num_boost_round,
+            evals=evals,
+            feval=feval,
+            callbacks=callbacks,
+            initial_model=initial,
         )
 
     if xgb_model is None:

@@ -78,10 +78,12 @@ class LinearModel:
         for f in range(self.num_feature):
             flat.extend(float(self.weights[f, g]) for g in range(G))
         flat.extend(float(b) for b in self.bias)
+        attributes = dict(self.attributes)
+        attributes.setdefault("num_boosted_rounds", str(self.rounds))
         doc = {
             "version": [3, 0, 0],
             "learner": {
-                "attributes": self.attributes,
+                "attributes": attributes,
                 "feature_names": [],
                 "feature_types": [],
                 "gradient_booster": {
@@ -115,7 +117,7 @@ class LinearModel:
         bias = flat[num_feature * G : num_feature * G + G]
         from .forest import _parse_base_score
 
-        return cls(
+        model = cls(
             weights,
             bias,
             objective_name=learner["objective"]["name"],
@@ -123,10 +125,20 @@ class LinearModel:
             num_feature=num_feature,
             num_class=num_class,
         )
+        model.attributes = dict(learner.get("attributes", {}))
+        try:
+            model.rounds = int(model.attributes.pop("num_boosted_rounds", 0))
+        except (TypeError, ValueError):
+            model.rounds = 0
+        return model
 
 
-def train_linear(config, dtrain, num_boost_round, evals=(), feval=None, callbacks=None):
-    """Train a gblinear model; mirrors booster.train's loop contract."""
+def train_linear(
+    config, dtrain, num_boost_round, evals=(), feval=None, callbacks=None, initial_model=None
+):
+    """Train a gblinear model; mirrors booster.train's loop contract.
+
+    initial_model: a LinearModel to continue from (checkpoint resume)."""
     from . import eval_metrics
     from .booster import _eval_metric_names
 
@@ -150,8 +162,14 @@ def train_linear(config, dtrain, num_boost_round, evals=(), feval=None, callback
     eta = config.eta
     lambda_bias = float(config.objective_params.get("lambda_bias", 0.0))
 
-    w = jnp.zeros((d, G), jnp.float32)
-    b = jnp.zeros(G, jnp.float32)
+    if initial_model is not None:
+        w = jnp.asarray(initial_model.weights.reshape(d, G))
+        b = jnp.asarray(initial_model.bias.reshape(G))
+        start_round = initial_model.num_boosted_rounds
+    else:
+        w = jnp.zeros((d, G), jnp.float32)
+        b = jnp.zeros(G, jnp.float32)
+        start_round = 0
 
     def margin_of(wc, bc):
         m = x @ wc + bc[None, :] + base
@@ -199,9 +217,10 @@ def train_linear(config, dtrain, num_boost_round, evals=(), feval=None, callback
     )
     metric_names = _eval_metric_names(config, objective)
 
+    model.rounds = start_round
     evals_log = {}
     stop = False
-    for rnd in range(num_boost_round):
+    for rnd in range(start_round, start_round + num_boost_round):
         w, b = one_round(w, b)
         model.weights = np.asarray(w)
         model.bias = np.asarray(b)

@@ -193,3 +193,16 @@ def test_lossguide_requires_max_leaves():
             DataMatrix(X, labels=y),
             num_boost_round=1,
         )
+
+
+def test_gblinear_checkpoint_resume(tmp_path):
+    X, y = _linear_data(800, seed=11)
+    dtrain = DataMatrix(X, labels=y)
+    params = {"booster": "gblinear", "eta": 0.5}
+    half = train(params, dtrain, num_boost_round=20)
+    path = str(tmp_path / "ckpt")
+    half.save_model(path)
+    resumed = train(params, dtrain, num_boost_round=20, xgb_model=path)
+    assert resumed.num_boosted_rounds == 40
+    full = train(params, dtrain, num_boost_round=40)
+    np.testing.assert_allclose(resumed.weights, full.weights, rtol=1e-4, atol=1e-5)

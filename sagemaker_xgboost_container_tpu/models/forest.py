@@ -257,6 +257,50 @@ class Forest:
             return margin
         return self.objective().margin_to_prediction(margin)
 
+    # ------------------------------------------------------------ importance
+    def get_score(self, importance_type="weight"):
+        """Feature importances (xgboost Booster.get_score semantics).
+
+        weight: split counts; [total_]gain / [total_]cover: summed loss change
+        / summed hessian at splits, averaged for the non-total variants. Keys
+        are feature names when known, else ``f<index>``.
+        """
+        valid = ("weight", "gain", "cover", "total_gain", "total_cover")
+        if importance_type not in valid:
+            raise exc.UserError(
+                "importance_type must be one of {}".format(", ".join(valid))
+            )
+        counts = {}
+        gains = {}
+        covers = {}
+        for tree in self.trees:
+            split_mask = ~tree.is_leaf
+            for f, g, c in zip(
+                tree.feature[split_mask], tree.gain[split_mask], tree.sum_hess[split_mask]
+            ):
+                f = int(f)
+                counts[f] = counts.get(f, 0) + 1
+                gains[f] = gains.get(f, 0.0) + float(g)
+                covers[f] = covers.get(f, 0.0) + float(c)
+
+        def name(f):
+            if self.feature_names and f < len(self.feature_names):
+                return self.feature_names[f]
+            return "f{}".format(f)
+
+        if importance_type == "weight":
+            return {name(f): v for f, v in counts.items()}
+        if importance_type == "total_gain":
+            return {name(f): v for f, v in gains.items()}
+        if importance_type == "total_cover":
+            return {name(f): v for f, v in covers.items()}
+        if importance_type == "gain":
+            return {name(f): gains[f] / counts[f] for f in counts}
+        return {name(f): covers[f] / counts[f] for f in counts}
+
+    def get_fscore(self):
+        return self.get_score("weight")
+
     # ----------------------------------------------------------------- json
     _OBJECTIVE_PARAM_BLOCKS = {
         "reg:squarederror": ("reg_loss_param", {"scale_pos_weight": "1"}),

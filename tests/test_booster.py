@@ -247,3 +247,22 @@ def test_ubjson_save_roundtrip(tmp_path):
         assert f.read(1) == b"{"  # UBJ object marker, not JSON text
     loaded, fmt = load_model_any_format(path)
     np.testing.assert_allclose(loaded.predict(X), forest.predict(X), rtol=1e-6)
+
+
+def test_feature_importance():
+    rng = np.random.RandomState(8)
+    X = rng.rand(800, 4).astype(np.float32)
+    # feature 2 carries nearly all signal
+    y = (X[:, 2] * 10 + X[:, 0] * 0.5).astype(np.float32)
+    forest = train({"max_depth": 4}, DataMatrix(X, labels=y), num_boost_round=10)
+    weight = forest.get_score("weight")
+    gain = forest.get_score("gain")
+    total_gain = forest.get_score("total_gain")
+    assert max(total_gain, key=total_gain.get) == "f2"
+    assert weight["f2"] >= 1
+    assert set(gain) <= {"f0", "f1", "f2", "f3"}
+    # invalid type rejected
+    from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+    with pytest.raises(exc.UserError):
+        forest.get_score("nope")

@@ -301,6 +301,49 @@ class Forest:
     def get_fscore(self):
         return self.get_score("weight")
 
+    def get_dump(self, with_stats=False):
+        """Text dump of every tree (xgboost ``Booster.get_dump`` format)."""
+
+        def name(f):
+            if self.feature_names and f < len(self.feature_names):
+                return self.feature_names[f]
+            return "f{}".format(f)
+
+        dumps = []
+        for tree in self.trees:
+            lines = {}
+
+            def walk(node, depth):
+                indent = "\t" * depth
+                if tree.is_leaf[node]:
+                    line = "{}{}:leaf={:.9g}".format(indent, node, float(tree.value[node]))
+                    if with_stats:
+                        line += ",cover={:.9g}".format(float(tree.sum_hess[node]))
+                else:
+                    left, right = int(tree.left[node]), int(tree.right[node])
+                    missing = left if tree.default_left[node] else right
+                    line = "{}{}:[{}<{:.9g}] yes={},no={},missing={}".format(
+                        indent,
+                        node,
+                        name(int(tree.feature[node])),
+                        float(tree.threshold[node]),
+                        left,
+                        right,
+                        missing,
+                    )
+                    if with_stats:
+                        line += ",gain={:.9g},cover={:.9g}".format(
+                            float(tree.gain[node]), float(tree.sum_hess[node])
+                        )
+                lines[node] = line
+                if not tree.is_leaf[node]:
+                    walk(int(tree.left[node]), depth + 1)
+                    walk(int(tree.right[node]), depth + 1)
+
+            walk(0, 0)
+            dumps.append("\n".join(lines[k] for k in sorted(lines)) + "\n")
+        return dumps
+
     # ----------------------------------------------------------------- json
     _OBJECTIVE_PARAM_BLOCKS = {
         "reg:squarederror": ("reg_loss_param", {"scale_pos_weight": "1"}),

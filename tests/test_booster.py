@@ -266,3 +266,17 @@ def test_feature_importance():
 
     with pytest.raises(exc.UserError):
         forest.get_score("nope")
+
+
+def test_get_dump_format():
+    rng = np.random.RandomState(9)
+    X = rng.rand(300, 3).astype(np.float32)
+    y = (X[:, 1] * 5).astype(np.float32)
+    forest = train({"max_depth": 2}, DataMatrix(X, labels=y), num_boost_round=2)
+    dumps = forest.get_dump(with_stats=True)
+    assert len(dumps) == 2
+    first = dumps[0].splitlines()
+    assert first[0].startswith("0:[f")
+    assert "yes=" in first[0] and "no=" in first[0] and "missing=" in first[0]
+    assert any("leaf=" in line for line in first)
+    assert "gain=" in first[0] and "cover=" in first[0]

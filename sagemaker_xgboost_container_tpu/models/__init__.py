@@ -1,6 +1,6 @@
 from .booster import TrainConfig, train  # noqa: F401
 from .forest import Forest, Tree  # noqa: F401
+from .objectives import create_objective  # noqa: F401
 
 # familiar alias for script-mode users porting xgboost code
 Booster = Forest
-from .objectives import create_objective  # noqa: F401

@@ -78,7 +78,14 @@ class TrainConfig:
         self.gamma = float(p.get("gamma", 0.0))
         self.min_child_weight = float(p.get("min_child_weight", 1.0))
         self.max_delta_step = float(p.get("max_delta_step", 0.0))
-        self.max_bin = int(p.get("max_bin", 256) or 256)
+        if p.get("max_bin") is not None:
+            self.max_bin = int(p["max_bin"])
+        elif p.get("sketch_eps"):
+            # approx-method users control sketch granularity via sketch_eps;
+            # bins ~ 1/eps is xgboost's own guidance for the hist equivalent
+            self.max_bin = int(min(max(1.0 / float(p["sketch_eps"]), 2), 1024))
+        else:
+            self.max_bin = 256
         self.subsample = float(p.get("subsample", 1.0))
         self.colsample_bytree = float(p.get("colsample_bytree", 1.0))
         self.colsample_bylevel = float(p.get("colsample_bylevel", 1.0))

@@ -1,0 +1,153 @@
+"""sklearn-style estimator wrappers over the TPU booster.
+
+The reference's script-mode examples train through xgboost's sklearn API
+(test/resources/boston/single_machine_customer_script.py uses
+``xgb.XGBRegressor`` + sklearn model selection). These wrappers give user
+scripts the same shape: ``fit/predict/predict_proba/score`` plus
+``get_params/set_params`` so sklearn's CV utilities compose.
+"""
+
+import numpy as np
+from sklearn.base import BaseEstimator as _SKBase
+from sklearn.base import ClassifierMixin as _SKClassifierMixin
+from sklearn.base import RegressorMixin as _SKRegressorMixin
+
+from .data.matrix import DataMatrix
+from .models import train as _train
+
+_FIT_PARAM_NAMES = (
+    "max_depth",
+    "eta",
+    "gamma",
+    "min_child_weight",
+    "subsample",
+    "colsample_bytree",
+    "colsample_bylevel",
+    "reg_lambda",
+    "reg_alpha",
+    "max_bin",
+    "seed",
+    "booster",
+    "grow_policy",
+    "max_leaves",
+    "num_parallel_tree",
+)
+_RENAMES = {"reg_lambda": "lambda", "reg_alpha": "alpha", "eta": "eta"}
+
+
+class _BaseEstimator(_SKBase):
+    _objective = "reg:squarederror"
+
+    def __init__(self, n_estimators=100, objective=None, **params):
+        self.n_estimators = n_estimators
+        self.objective = objective or self._objective
+        self.params = params
+        self._model = None
+
+    # -- sklearn protocol ----------------------------------------------------
+    def get_params(self, deep=True):
+        out = {"n_estimators": self.n_estimators, "objective": self.objective}
+        out.update(self.params)
+        return out
+
+    def set_params(self, **params):
+        self.n_estimators = params.pop("n_estimators", self.n_estimators)
+        self.objective = params.pop("objective", self.objective)
+        self.params.update(params)
+        return self
+
+    # -- training ------------------------------------------------------------
+    def _train_params(self):
+        cfg = {"objective": self.objective}
+        for key, value in self.params.items():
+            cfg[_RENAMES.get(key, key)] = value
+        return cfg
+
+    def fit(self, X, y, sample_weight=None, eval_set=None, verbose=False):
+        cfg = self._train_params()
+        dtrain = DataMatrix(
+            np.asarray(X, np.float32), labels=np.asarray(y, np.float32),
+            weights=sample_weight,
+        )
+        evals = []
+        if eval_set:
+            for i, (Xv, yv) in enumerate(eval_set):
+                evals.append(
+                    (DataMatrix(np.asarray(Xv, np.float32), labels=np.asarray(yv, np.float32)),
+                     "validation_{}".format(i))
+                )
+        self._model = _train(cfg, dtrain, num_boost_round=self.n_estimators, evals=evals)
+        return self
+
+    def _check_fitted(self):
+        if self._model is None:
+            raise RuntimeError("Estimator is not fitted yet; call fit() first")
+
+    @property
+    def booster_(self):
+        self._check_fitted()
+        return self._model
+
+    def get_booster(self):
+        return self.booster_
+
+    def save_model(self, path):
+        self.booster_.save_model(path)
+
+
+class TPUXGBRegressor(_SKRegressorMixin, _BaseEstimator):
+    _objective = "reg:squarederror"
+
+    def predict(self, X):
+        self._check_fitted()
+        return np.asarray(self._model.predict(np.asarray(X, np.float32)))
+
+    def score(self, X, y):
+        from sklearn.metrics import r2_score
+
+        return float(r2_score(y, self.predict(X)))
+
+
+class TPUXGBClassifier(_SKClassifierMixin, _BaseEstimator):
+    _objective = "binary:logistic"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self.classes_ = np.unique(y)
+        if len(self.classes_) > 2 and not str(self.objective).startswith("multi:"):
+            self.objective = "multi:softprob"
+            self.params.setdefault("num_class", len(self.classes_))
+        return super().fit(X, y, **kwargs)
+
+    def predict_proba(self, X):
+        self._check_fitted()
+        out = np.asarray(self._model.predict(np.asarray(X, np.float32)))
+        if out.ndim == 1:  # binary: P(class 1)
+            return np.stack([1 - out, out], axis=1)
+        return out
+
+    def predict(self, X):
+        proba = self.predict_proba(X)
+        return self.classes_[np.argmax(proba, axis=1)]
+
+    def score(self, X, y):
+        return float((self.predict(X) == np.asarray(y)).mean())
+
+
+class TPUXGBRanker(_BaseEstimator):
+    _objective = "rank:ndcg"
+
+    def fit(self, X, y, group=None, sample_weight=None, verbose=False):
+        cfg = self._train_params()
+        dtrain = DataMatrix(
+            np.asarray(X, np.float32),
+            labels=np.asarray(y, np.float32),
+            weights=sample_weight,
+            groups=None if group is None else np.asarray(group, np.int32),
+        )
+        self._model = _train(cfg, dtrain, num_boost_round=self.n_estimators)
+        return self
+
+    def predict(self, X):
+        self._check_fitted()
+        return np.asarray(self._model.predict(np.asarray(X, np.float32)))

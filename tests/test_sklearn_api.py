@@ -1,0 +1,60 @@
+"""sklearn-wrapper tests: fit/predict/score + sklearn CV composition."""
+
+import numpy as np
+
+from sagemaker_xgboost_container_tpu.sklearn import (
+    TPUXGBClassifier,
+    TPUXGBRanker,
+    TPUXGBRegressor,
+)
+
+
+def test_regressor_fit_predict_score(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(600, 4)
+    y = X[:, 0] * 5 + X[:, 1]
+    est = TPUXGBRegressor(n_estimators=20, max_depth=3, eta=0.3)
+    est.fit(X, y)
+    assert est.score(X, y) > 0.9
+    est.save_model(str(tmp_path / "m.json"))
+    assert est.get_booster().num_boosted_rounds == 20
+
+
+def test_classifier_binary_and_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.randn(800, 4)
+    y = (X[:, 0] > 0).astype(int)
+    clf = TPUXGBClassifier(n_estimators=15, max_depth=3)
+    clf.fit(X, y)
+    assert clf.score(X, y) > 0.9
+    proba = clf.predict_proba(X)
+    assert proba.shape == (800, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    y3 = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    clf3 = TPUXGBClassifier(n_estimators=10, max_depth=3)
+    clf3.fit(X, y3)
+    assert clf3.predict_proba(X).shape == (800, 3)
+    assert clf3.score(X, y3) > 0.8
+
+
+def test_sklearn_cross_val_composes():
+    from sklearn.model_selection import cross_val_score
+
+    rng = np.random.RandomState(2)
+    X = rng.rand(300, 3)
+    y = X[:, 0] * 3
+    scores = cross_val_score(
+        TPUXGBRegressor(n_estimators=8, max_depth=2), X, y, cv=3
+    )
+    assert len(scores) == 3 and scores.mean() > 0.7
+
+
+def test_ranker():
+    rng = np.random.RandomState(3)
+    X = rng.randn(200, 3)
+    y = (X[:, 0] > 0).astype(float)
+    ranker = TPUXGBRanker(n_estimators=10, max_depth=3)
+    ranker.fit(X, y, group=np.full(20, 10))
+    s = ranker.predict(X)
+    assert np.corrcoef(s, y)[0, 1] > 0.5

@@ -31,7 +31,7 @@ logger = logging.getLogger(__name__)
 
 class ModelManager:
     def __init__(self, max_models=None):
-        self._models = collections.OrderedDict()  # name -> (model, format, dir)
+        self._models = collections.OrderedDict()  # name -> (model, fmt, dir, batcher)
         self._lock = threading.Lock()
         self.max_models = max_models or int(os.getenv("SAGEMAKER_MAX_MODELS", "0")) or None
 
@@ -42,10 +42,18 @@ class ModelManager:
         model, fmt = serve_utils.get_loaded_booster(
             model_dir, serve_utils.is_ensemble_enabled()
         )
+        batcher = None
+        if not isinstance(model, list):
+            from .batcher import PredictBatcher
+
+            rng = serve_utils.best_iteration_range(model)
+            batcher = PredictBatcher(
+                lambda feats, _m=model, _r=rng: _m.predict(feats, iteration_range=_r)
+            )
         with self._lock:
             if name in self._models:
                 raise KeyError("model {} is already loaded".format(name))
-            self._models[name] = (model, fmt, model_dir)
+            self._models[name] = (model, fmt, model_dir, batcher)
             if self.max_models and len(self._models) > self.max_models:
                 evicted, _ = self._models.popitem(last=False)
                 logger.info("Evicted model %s (LRU cap %d)", evicted, self.max_models)
@@ -123,7 +131,7 @@ def make_mme_app(manager=None):
                 name = remainder
                 if method == "GET":
                     try:
-                        _model, fmt, model_dir = manager.get(name)
+                        _model, fmt, model_dir, _batcher = manager.get(name)
                     except KeyError:
                         return _response(start_response, http.client.NOT_FOUND, "model not found")
                     body = json.dumps([{"modelName": name, "modelUrl": model_dir, "format": fmt}])
@@ -165,7 +173,7 @@ def _query_params(environ):
 
 def _invoke(manager, name, environ, start_response):
     try:
-        model, fmt, _dir = manager.get(name)
+        model, fmt, _dir, batcher = manager.get(name)
     except KeyError:
         return _response(start_response, http.client.NOT_FOUND, "model not found")
     payload = _read_body(environ)
@@ -182,9 +190,15 @@ def _invoke(manager, name, environ, start_response):
         return _response(start_response, http.client.NOT_ACCEPTABLE, str(e))
     try:
         first = model[0] if isinstance(model, list) else model
-        preds = serve_utils.predict(
-            model, fmt, dtest, parsed_type, objective=first.objective_name
-        )
+        if batcher is not None:
+            from ..data.content_types import get_content_type
+
+            serve_utils._check_feature_count(first, dtest, get_content_type(parsed_type))
+            preds = batcher.predict(serve_utils.canonicalize_features(first, dtest))
+        else:
+            preds = serve_utils.predict(
+                model, fmt, dtest, parsed_type, objective=first.objective_name
+            )
     except Exception as e:
         logger.exception("invoke predict failed")
         return _response(start_response, http.client.BAD_REQUEST, str(e))

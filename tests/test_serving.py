@@ -459,3 +459,25 @@ class TestEnsembleAndBatchMode:
             assert body.endswith(b"\n")
         finally:
             httpd.shutdown()
+
+
+def test_invocations_recordio_accept(abalone_model_dir):
+    app = make_app(ScoringService(abalone_model_dir))
+    base, httpd = _serve(app)
+    try:
+        status, body, _ = _request(
+            base + "/invocations",
+            method="POST",
+            data=LIBSVM_PAYLOAD,
+            headers={
+                "Content-Type": "text/libsvm",
+                "Accept": "application/x-recordio-protobuf",
+            },
+        )
+        assert status == 200
+        from sagemaker_xgboost_container_tpu.data.recordio import read_recordio_protobuf
+
+        feats, _labels = read_recordio_protobuf(body)
+        assert feats.shape[0] == 1
+    finally:
+        httpd.shutdown()

@@ -280,3 +280,32 @@ def test_get_dump_format():
     assert "yes=" in first[0] and "no=" in first[0] and "missing=" in first[0]
     assert any("leaf=" in line for line in first)
     assert "gain=" in first[0] and "cover=" in first[0]
+
+
+def test_output_margin_and_iteration_range():
+    rng = np.random.RandomState(10)
+    X = rng.rand(400, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    forest = train(
+        {"objective": "binary:logistic", "max_depth": 3}, DataMatrix(X, labels=y),
+        num_boost_round=6,
+    )
+    margin = forest.predict(X, output_margin=True)
+    prob = forest.predict(X)
+    np.testing.assert_allclose(prob, 1 / (1 + np.exp(-margin)), rtol=1e-5)
+    # iteration_range truncates the ensemble (ntree_limit analog)
+    m3 = forest.predict_margin(X, iteration_range=(0, 3))
+    full = forest.predict_margin(X)
+    assert not np.allclose(m3, full)
+    # first-3-rounds model == iteration_range(0,3)
+    import json
+
+    doc = json.loads(forest.save_json())
+    doc["learner"]["gradient_booster"]["model"]["trees"] = doc["learner"][
+        "gradient_booster"
+    ]["model"]["trees"][:3]
+    doc["learner"]["gradient_booster"]["model"]["tree_info"] = [0, 0, 0]
+    doc["learner"]["gradient_booster"]["model"]["iteration_indptr"] = [0, 1, 2, 3]
+    doc["learner"]["gradient_booster"]["model"]["gbtree_model_param"]["num_trees"] = "3"
+    truncated = Forest.load_json(json.dumps(doc))
+    np.testing.assert_allclose(truncated.predict_margin(X), m3, rtol=1e-5)

@@ -85,7 +85,9 @@ def make_device_metric(name, objective_name, num_group=1, params=None):
         )
     if base == "logloss":
         def logloss(p, y, w):
-            p = jnp.clip(p, _EPS, 1 - _EPS)
+            # f32-safe: clip with an epsilon representable in float32
+            eps32 = 1e-7
+            p = jnp.clip(p, eps32, 1 - eps32)
             return _weighted_mean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
 
         return with_pred(logloss)

@@ -58,7 +58,9 @@ def mphe(preds, labels, weights=None, slope=1.0):
 
 def logloss(preds, labels, weights=None):
     w = _w(weights, labels)
-    p = np.clip(preds, _EPS, 1 - _EPS)
+    # float64 before clipping: in float32, 1 - 1e-15 rounds to exactly 1.0
+    # and saturated probabilities produce log(0) -> nan
+    p = np.clip(np.asarray(preds, np.float64), _EPS, 1 - _EPS)
     return float(-np.sum(w * (labels * np.log(p) + (1 - labels) * np.log(1 - p))) / np.sum(w))
 
 
@@ -107,7 +109,11 @@ def merror(prob_matrix, labels, weights=None):
 
 def mlogloss(prob_matrix, labels, weights=None):
     w = _w(weights, labels)
-    p = np.clip(prob_matrix[np.arange(len(labels)), labels.astype(int)], _EPS, 1.0)
+    p = np.clip(
+        np.asarray(prob_matrix, np.float64)[np.arange(len(labels)), labels.astype(int)],
+        _EPS,
+        1.0,
+    )
     return float(-np.sum(w * np.log(p)) / np.sum(w))
 
 

@@ -1,0 +1,52 @@
+"""Every supported objective trains, predicts finitely, and reduces loss."""
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+
+RNG = np.random.RandomState(0)
+N = 300
+X = RNG.rand(N, 4).astype(np.float32)
+SIGNAL = X[:, 0] * 2 + X[:, 1]
+
+CASES = [
+    ("reg:squarederror", SIGNAL, {}),
+    ("reg:linear", SIGNAL, {}),
+    ("reg:logistic", (SIGNAL > 1.2).astype(np.float32), {}),
+    ("reg:squaredlogerror", SIGNAL + 0.5, {}),
+    ("reg:pseudohubererror", SIGNAL, {}),
+    ("reg:absoluteerror", SIGNAL, {}),
+    ("reg:gamma", SIGNAL + 0.5, {}),
+    ("reg:tweedie", SIGNAL + 0.5, {"tweedie_variance_power": "1.3"}),
+    ("binary:logistic", (SIGNAL > 1.2).astype(np.float32), {}),
+    ("binary:logitraw", (SIGNAL > 1.2).astype(np.float32), {"eval_metric": "error"}),
+    ("binary:hinge", (SIGNAL > 1.2).astype(np.float32), {}),
+    ("count:poisson", np.round(SIGNAL + 1), {}),
+    ("multi:softmax", np.clip(np.round(SIGNAL), 0, 2), {"num_class": 3}),
+    ("multi:softprob", np.clip(np.round(SIGNAL), 0, 2), {"num_class": 3}),
+    ("survival:aft", SIGNAL + 0.5, {"base_score": "1.0", "eval_metric": "rmse"}),
+    ("survival:cox", SIGNAL + 0.5, {"eval_metric": "cox-nloglik"}),
+]
+
+
+@pytest.mark.parametrize("objective,labels,extra", CASES, ids=[c[0] for c in CASES])
+def test_objective_trains(objective, labels, extra):
+    params = {"objective": objective, "max_depth": 3, "eta": 0.3}
+    params.update(extra)
+    dtrain = DataMatrix(X, labels=np.asarray(labels, np.float32))
+    log = {}
+
+    class Rec:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update({k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()})
+            return False
+
+    forest = train(params, dtrain, num_boost_round=5, evals=[(dtrain, "train")], callbacks=[Rec()])
+    preds = forest.predict(X)
+    assert np.isfinite(np.asarray(preds)).all(), objective
+    series = next(iter(log["train"].values()))
+    assert len(series) == 5
+    if objective not in ("binary:hinge",):  # hinge error can plateau at 0
+        assert series[-1] <= series[0] + 1e-6, (objective, series)

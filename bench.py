@@ -43,7 +43,10 @@ def _supervised_main():
             if line.startswith("{"):
                 print(line)
                 return
-        note = "benchmark child produced no result (rc={})".format(result.returncode)
+        err_tail = " | ".join(result.stderr.strip().splitlines()[-3:])[-400:]
+        note = "benchmark child produced no result (rc={}): {}".format(
+            result.returncode, err_tail
+        )
     except subprocess.TimeoutExpired:
         note = "benchmark timed out after {}s (TPU tunnel unavailable?)".format(
             BENCH_TIMEOUT_S

@@ -481,3 +481,25 @@ def test_invocations_recordio_accept(abalone_model_dir):
         assert feats.shape[0] == 1
     finally:
         httpd.shutdown()
+
+
+def test_ensemble_vote_for_softmax(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.randn(300, 3).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(np.float32)
+    for seed in (1, 2, 3):
+        m = train(
+            {"objective": "multi:softmax", "num_class": 3, "max_depth": 3, "seed": seed,
+             "subsample": 0.8},
+            DataMatrix(X, labels=y),
+            num_boost_round=4,
+        )
+        m.save_model(str(tmp_path / ("xgboost-model-%d" % seed)))
+    model, fmt = serve_utils.get_loaded_booster(str(tmp_path), ensemble=True)
+    assert len(model) == 3
+    preds = serve_utils.predict(
+        model, fmt, DataMatrix(X[:20]), "text/csv", "multi:softmax"
+    )
+    preds = np.asarray(preds)
+    assert preds.shape == (20,)
+    assert set(np.unique(preds)).issubset({0.0, 1.0, 2.0})

@@ -1,0 +1,42 @@
+#!/bin/bash
+# One-command end-to-end smoke: abalone train -> model -> serve -> predict.
+# Runs on CPU (JAX_PLATFORMS=cpu); ~1 minute.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="$(mktemp -d)"
+trap 'rm -rf "$WORK"; kill $SERVER_PID 2>/dev/null || true' EXIT
+mkdir -p "$WORK"/{conf,model,out}
+
+cat > "$WORK/conf/hyperparameters.json" <<'JSON'
+{"num_round": "10", "objective": "reg:squarederror", "max_depth": "4", "eval_metric": "rmse"}
+JSON
+cat > "$WORK/conf/inputdataconfig.json" <<'JSON'
+{"train": {"ContentType": "libsvm", "TrainingInputMode": "File", "S3DistributionType": "FullyReplicated"},
+ "validation": {"ContentType": "libsvm", "TrainingInputMode": "File", "S3DistributionType": "FullyReplicated"}}
+JSON
+
+export JAX_PLATFORMS=cpu PYTHONPATH="$REPO"
+export SM_INPUT_TRAINING_CONFIG_FILE="$WORK/conf/hyperparameters.json"
+export SM_INPUT_DATA_CONFIG_FILE="$WORK/conf/inputdataconfig.json"
+export SM_CHECKPOINT_CONFIG_FILE="$WORK/conf/checkpointconfig.json"
+export SM_CHANNEL_TRAIN=/root/reference/test/resources/abalone/data/train
+export SM_CHANNEL_VALIDATION=/root/reference/test/resources/abalone/data/validation
+export SM_MODEL_DIR="$WORK/model" SM_OUTPUT_DATA_DIR="$WORK/out"
+export SM_HOSTS='["algo-1"]' SM_CURRENT_HOST=algo-1
+
+echo "== train =="
+python -m sagemaker_xgboost_container_tpu.training.entry 2>/dev/null | tail -3
+test -f "$WORK/model/xgboost-model"
+
+echo "== serve =="
+SAGEMAKER_BIND_TO_PORT=18099 python -m sagemaker_xgboost_container_tpu.serving.server \
+  > "$WORK/serve.log" 2>&1 &
+SERVER_PID=$!
+for i in $(seq 1 30); do
+  curl -sf localhost:18099/ping >/dev/null 2>&1 && break; sleep 1
+done
+echo -n "prediction: "
+curl -s -X POST localhost:18099/invocations -H "Content-Type: text/libsvm" \
+  -d "1:2 2:0.74 3:0.6 4:0.195 5:1.974 6:0.598 7:0.4085 8:0.71"
+echo
+echo "SMOKE OK"

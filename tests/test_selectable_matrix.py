@@ -1,0 +1,109 @@
+"""Selectable-inference extractor semantics: the per-objective key matrix.
+
+Behavioral parity with the reference's test_serve_utils.py extractor cases
+(predicted_label/probability/probabilities/raw_score(s)/labels per objective,
+NaN for inapplicable keys, ValueError for unsupported objectives).
+"""
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.serving import serve_utils as su
+
+
+@pytest.mark.parametrize(
+    "objective,raw,expected",
+    [
+        (su.BINARY_HINGE, np.int64(0), 0),
+        (su.BINARY_LOG, np.float64(0.6), 1),
+        (su.BINARY_LOGRAW, np.float64(-7.6), 0),
+        (su.MULTI_SOFTPROB, np.array([0.1, 0.5, 0.4]), 1),
+        (su.MULTI_SOFTMAX, np.float64(2.0), 2),
+    ],
+)
+def test_predicted_label(objective, raw, expected):
+    assert su._get_predicted_label(objective, raw) == expected
+
+
+def test_predicted_label_nan_for_regression():
+    assert np.isnan(su._get_predicted_label(su.REG_LOG, 0))
+
+
+@pytest.mark.parametrize(
+    "objective,num_class,expected",
+    [(su.BINARY_LOG, "", [0, 1]), (su.MULTI_SOFTPROB, "7", list(range(7)))],
+)
+def test_labels(objective, num_class, expected):
+    assert su._get_labels(objective, num_class=num_class) == expected
+
+
+def test_labels_nan():
+    assert np.isnan(su._get_labels(su.REG_LOG))
+
+
+@pytest.mark.parametrize(
+    "objective,raw,expected",
+    [(su.BINARY_LOG, np.float64(0.6), 0.6), (su.MULTI_SOFTPROB, np.array([0.1, 0.5, 0.4]), 0.5)],
+)
+def test_probability(objective, raw, expected):
+    assert su._get_probability(objective, raw) == pytest.approx(expected)
+
+
+def test_probability_nan_for_hinge():
+    assert np.isnan(su._get_probability(su.BINARY_HINGE, 0))
+
+
+@pytest.mark.parametrize(
+    "objective,raw,expected",
+    [
+        (su.BINARY_LOG, np.float64(0.6), [0.4, 0.6]),
+        (su.MULTI_SOFTPROB, np.array([0.1, 0.5, 0.4]), [0.1, 0.5, 0.4]),
+    ],
+)
+def test_probabilities(objective, raw, expected):
+    assert su._get_probabilities(objective, raw) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize(
+    "objective,raw,expected",
+    [
+        (su.BINARY_LOG, np.float64(0.6), 0.6),
+        (su.MULTI_SOFTPROB, np.array([0.1, 0.5, 0.4]), 0.5),
+        (su.BINARY_LOGRAW, np.float64(-7.6), -7.6),
+        (su.MULTI_SOFTMAX, np.float64(2.0), 2.0),
+    ],
+)
+def test_raw_score(objective, raw, expected):
+    assert su._get_raw_score(objective, raw) == pytest.approx(expected)
+
+
+def test_selected_predictions_with_invalid_keys_get_nan():
+    preds = su.get_selected_predictions(
+        np.array([0.6, 32.0]), ["predicted_score", "predicted_label", "foo"], su.REG_LOG
+    )
+    assert preds[0]["predicted_score"] == pytest.approx(0.6)
+    assert np.isnan(preds[0]["predicted_label"])
+    assert np.isnan(preds[0]["foo"])
+    assert preds[1]["predicted_score"] == pytest.approx(32.0)
+
+
+def test_selected_predictions_unsupported_objective():
+    with pytest.raises(ValueError):
+        su.get_selected_predictions(np.array([0.5]), ["predicted_score"], "rank:pairwise")
+
+
+def test_binary_log_full_matrix():
+    preds = su.get_selected_predictions(
+        np.array([0.7, 0.2]),
+        ["predicted_label", "labels", "probability", "probabilities", "raw_score", "raw_scores"],
+        su.BINARY_LOG,
+    )
+    assert preds[0] == {
+        "predicted_label": 1,
+        "labels": [0, 1],
+        "probability": pytest.approx(0.7),
+        "probabilities": pytest.approx([0.3, 0.7]),
+        "raw_score": pytest.approx(0.7),
+        "raw_scores": pytest.approx([0.3, 0.7]),
+    }
+    assert preds[1]["predicted_label"] == 0

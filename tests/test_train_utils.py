@@ -1,0 +1,47 @@
+"""Metric plumbing tests (reference test_train_utils semantics)."""
+
+from sagemaker_xgboost_container_tpu.training import train_utils
+
+
+def test_union_metrics_sorted_and_deduped():
+    assert train_utils.get_union_metrics(None, None) is None
+    assert train_utils.get_union_metrics(["auc"], None) == ["auc"]
+    assert train_utils.get_union_metrics(None, ["rmse"]) == ["rmse"]
+    assert train_utils.get_union_metrics(["rmse", "auc"], ["auc", "error"]) == [
+        "auc",
+        "error",
+        "rmse",
+    ]
+
+
+def test_eval_metrics_and_feval_split():
+    native, feval, tuning = train_utils.get_eval_metrics_and_feval(
+        "validation:accuracy", ["logloss", "f1"]
+    )
+    # accuracy + f1 are sklearn-backed; logloss is native
+    assert native == ["logloss"]
+    assert feval is not None
+    assert tuning == ["accuracy"]
+
+
+def test_eval_metrics_all_native():
+    native, feval, tuning = train_utils.get_eval_metrics_and_feval(
+        "validation:rmse", ["logloss"]
+    )
+    assert sorted(native) == ["logloss", "rmse"]
+    assert feval is None
+
+
+def test_metric_name_components():
+    c = train_utils.MetricNameComponents.decode("validation:auc")
+    assert c.data_segment == "validation"
+    assert c.metric_name == "auc"
+
+
+def test_cleanup_dir(tmp_path):
+    (tmp_path / "xgboost-model").write_text("keep")
+    (tmp_path / "xgboost-model-0").write_text("keep")
+    (tmp_path / "junk.tmp").write_text("rm")
+    train_utils.cleanup_dir(str(tmp_path), "xgboost-model")
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["xgboost-model", "xgboost-model-0"]

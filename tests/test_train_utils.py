@@ -24,12 +24,15 @@ def test_eval_metrics_and_feval_split():
     assert tuning == ["accuracy"]
 
 
-def test_eval_metrics_all_native():
+def test_eval_metrics_rmse_rides_feval():
+    # rmse is in CUSTOM_METRICS (as in the reference custom_metrics.py:233-249),
+    # so it routes through feval while logloss stays native
     native, feval, tuning = train_utils.get_eval_metrics_and_feval(
         "validation:rmse", ["logloss"]
     )
-    assert sorted(native) == ["logloss", "rmse"]
-    assert feval is None
+    assert native == ["logloss"]
+    assert feval is not None
+    assert tuning == ["rmse"]
 
 
 def test_metric_name_components():

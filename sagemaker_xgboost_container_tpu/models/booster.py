@@ -585,7 +585,9 @@ class _TrainingSession:
                     scalars = jnp.stack(per_set)          # [n_sets, n_metrics]
                     extra = tuple(new_extra)
                 else:
-                    scalars = jnp.zeros((0, 0), jnp.float32)
+                    # non-empty dummy: zero-sized scan outputs are a
+                    # lowering hazard on some backends
+                    scalars = jnp.zeros((1, 1), jnp.float32)
                 return (margins_c, extra), (packed, scalars)
 
             (margins, eval_m), (packed_all, metrics_all) = jax.lax.scan(

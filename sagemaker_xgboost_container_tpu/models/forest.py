@@ -257,6 +257,19 @@ class Forest:
             return margin
         return self.objective().margin_to_prediction(margin)
 
+    # ------------------------------------------------------------ attributes
+    def attr(self, key):
+        """xgboost Booster.attr: stored attribute or None."""
+        return self.attributes.get(key)
+
+    def set_attr(self, **kwargs):
+        """xgboost Booster.set_attr: set (or delete with None) attributes."""
+        for key, value in kwargs.items():
+            if value is None:
+                self.attributes.pop(key, None)
+            else:
+                self.attributes[key] = str(value)
+
     # ------------------------------------------------------------ importance
     def get_score(self, importance_type="weight"):
         """Feature importances (xgboost Booster.get_score semantics).

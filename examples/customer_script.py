@@ -1,0 +1,61 @@
+"""Example script-mode training entry (the reference's boston example analog:
+test/resources/boston/single_machine_customer_script.py trains via the
+xgboost sklearn API with CV and saves model + cv_results + a report).
+
+Run standalone or as a SageMaker script-mode entry point
+(sagemaker_program=customer_script.py)."""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ.get("FRAMEWORK_REPO", "/opt/sagemaker-xgboost-container-tpu"))
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--max_depth", type=int, default=4)
+    parser.add_argument("--learning_rate", type=float, default=0.3)
+    parser.add_argument("--n_estimators", type=int, default=50)
+    parser.add_argument("--model-dir", default=os.environ.get("SM_MODEL_DIR", "."))
+    parser.add_argument(
+        "--output-data-dir", default=os.environ.get("SM_OUTPUT_DATA_DIR", ".")
+    )
+    args, _ = parser.parse_known_args()
+
+    from sklearn.model_selection import cross_val_score
+
+    from sagemaker_xgboost_container_tpu.sklearn import TPUXGBRegressor
+
+    # synthetic housing-style regression data
+    rng = np.random.RandomState(0)
+    X = rng.rand(2000, 8).astype(np.float32)
+    y = (
+        X[:, 0] * 8 + np.sin(X[:, 1] * 6) * 3 + X[:, 2] * X[:, 3] * 4
+        + rng.randn(2000) * 0.3
+    ).astype(np.float32)
+
+    est = TPUXGBRegressor(
+        n_estimators=args.n_estimators,
+        max_depth=args.max_depth,
+        eta=args.learning_rate,
+    )
+    scores = cross_val_score(est, X, y, cv=3)
+    est.fit(X, y)
+
+    os.makedirs(args.model_dir, exist_ok=True)
+    os.makedirs(args.output_data_dir, exist_ok=True)
+    est.save_model(os.path.join(args.model_dir, "xgboost-model"))
+    with open(os.path.join(args.output_data_dir, "cv_results.json"), "w") as f:
+        json.dump({"r2_per_fold": scores.tolist(), "r2_mean": float(scores.mean())}, f)
+    importances = est.get_booster().get_score("total_gain")
+    with open(os.path.join(args.output_data_dir, "feature_importance.json"), "w") as f:
+        json.dump(importances, f)
+    print("cv r2: {:.4f} +/- {:.4f}".format(scores.mean(), scores.std()))
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,29 @@ def make_device_metric(name, objective_name, num_group=1, params=None):
             return _weighted_mean(p - y * jnp.log(p) + gammaln(y + 1.0), w)
 
         return with_pred(poisson)
+    if base == "gamma-nloglik":
+        def gamma_nll(p, y, w):
+            p = jnp.maximum(p, _EPS)
+            return _weighted_mean(jnp.log(p) + y / p, w)
+
+        return with_pred(gamma_nll)
+    if base == "gamma-deviance":
+        def gamma_dev(p, y, w):
+            p = jnp.maximum(p, _EPS)
+            y = jnp.maximum(y, _EPS)
+            return 2.0 * _weighted_mean(jnp.log(p / y) + y / p - 1.0, w)
+
+        return with_pred(gamma_dev)
+    if base == "tweedie-nloglik":
+        rho = float(suffix) if suffix else float(params.get("tweedie_variance_power", 1.5))
+
+        def tweedie(p, y, w):
+            p = jnp.maximum(p, _EPS)
+            a = y * jnp.power(p, 1 - rho) / (1 - rho)
+            b = jnp.power(p, 2 - rho) / (2 - rho)
+            return _weighted_mean(-a + b, w)
+
+        return with_pred(tweedie)
     return None
 
 

@@ -32,6 +32,9 @@ def _data(seed=0, n=500, tie_frac=0.3):
         ("error", "binary:logistic"),
         ("error@0.3", "binary:logistic"),
         ("auc", "binary:logistic"),
+        ("gamma-nloglik", "reg:gamma"),
+        ("gamma-deviance", "reg:gamma"),
+        ("tweedie-nloglik", "reg:tweedie"),
     ],
 )
 def test_device_matches_host(name, objective):
@@ -44,6 +47,8 @@ def test_device_matches_host(name, objective):
     m, y, w = margins[:n_real], labels[:n_real], weights[:n_real]
     if objective == "binary:logistic":
         preds = 1.0 / (1.0 + np.exp(-m))
+    elif objective in ("reg:gamma", "reg:tweedie"):
+        preds = np.exp(m)
     else:
         preds = m
     want = eval_metrics.evaluate(name, preds, y, w)

@@ -251,11 +251,42 @@ class Forest:
         )
         return out[:n]
 
-    def predict(self, features, output_margin=False, iteration_range=None):
+    def predict(self, features, output_margin=False, iteration_range=None, pred_leaf=False):
+        if pred_leaf:
+            return self.predict_leaf(features, iteration_range=iteration_range)
         margin = self.predict_margin(features, iteration_range=iteration_range)
         if output_margin:
             return margin
         return self.objective().margin_to_prediction(margin)
+
+    def predict_leaf(self, features, iteration_range=None):
+        """Leaf index per (row, tree) — xgboost ``predict(pred_leaf=True)``."""
+        from ..ops.predict import _forest_leaf_nodes
+
+        if iteration_range is None:
+            lo, hi = 0, self.num_boosted_rounds
+        else:
+            lo, hi = iteration_range
+            hi = hi or self.num_boosted_rounds
+        stacked = self._stack(
+            slice(self.iteration_indptr[lo], self.iteration_indptr[hi])
+        )
+        features = np.asarray(features, np.float32)
+        if stacked is None:
+            return np.zeros((features.shape[0], 0), np.int32)
+        import jax.numpy as jnp
+
+        nodes = _forest_leaf_nodes(
+            jnp.asarray(stacked["feature"]),
+            jnp.asarray(stacked["threshold"]),
+            jnp.asarray(stacked["default_left"]),
+            jnp.asarray(stacked["left"]),
+            jnp.asarray(stacked["right"]),
+            jnp.asarray(stacked["is_leaf"]),
+            jnp.asarray(features),
+            stacked["depth"],
+        )
+        return np.asarray(nodes)
 
     # ------------------------------------------------------------ attributes
     def attr(self, key):

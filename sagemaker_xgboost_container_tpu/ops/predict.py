@@ -19,11 +19,8 @@ import numpy as np
 
 
 @partial(jax.jit, static_argnames=("depth",))
-def _forest_margin(feature, threshold, default_left, left, right, is_leaf, leaf_value, x, depth):
-    """x: f32 [n, d] (NaN = missing) -> per-tree-group margins [n].
-
-    Tree arrays: [T, N] stacked; leaves self-loop via left/right == own index.
-    """
+def _forest_leaf_nodes(feature, threshold, default_left, left, right, is_leaf, x, depth):
+    """x: f32 [n, d] (NaN = missing) -> leaf node index per (row, tree)."""
     n = x.shape[0]
     T = feature.shape[0]
     node = jnp.zeros((n, T), jnp.int32)
@@ -37,6 +34,20 @@ def _forest_margin(feature, threshold, default_left, left, right, is_leaf, leaf_
         go_right = jnp.where(miss, ~default_left[t_idx, node], v >= thr)
         nxt = jnp.where(go_right, right[t_idx, node], left[t_idx, node])
         node = jnp.where(is_leaf[t_idx, node], node, nxt)
+    return node
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _forest_margin(feature, threshold, default_left, left, right, is_leaf, leaf_value, x, depth):
+    """x: f32 [n, d] (NaN = missing) -> per-tree-group margins [n].
+
+    Tree arrays: [T, N] stacked; leaves self-loop via left/right == own index.
+    """
+    T = feature.shape[0]
+    t_idx = jnp.arange(T)[None, :]
+    node = _forest_leaf_nodes(
+        feature, threshold, default_left, left, right, is_leaf, x, depth
+    )
     return leaf_value[t_idx, node]             # [n, T]
 
 

@@ -309,3 +309,20 @@ def test_output_margin_and_iteration_range():
     doc["learner"]["gradient_booster"]["model"]["gbtree_model_param"]["num_trees"] = "3"
     truncated = Forest.load_json(json.dumps(doc))
     np.testing.assert_allclose(truncated.predict_margin(X), m3, rtol=1e-5)
+
+
+def test_pred_leaf():
+    rng = np.random.RandomState(11)
+    X = rng.rand(200, 3).astype(np.float32)
+    y = (X[:, 0] * 4).astype(np.float32)
+    forest = train({"max_depth": 3}, DataMatrix(X, labels=y), num_boost_round=4)
+    leaves = forest.predict(X, pred_leaf=True)
+    assert leaves.shape == (200, 4)
+    assert leaves.dtype == np.int32
+    # every reported node is a leaf of its tree
+    for t in range(4):
+        tree = forest.trees[t]
+        assert tree.is_leaf[leaves[:, t]].all()
+    # rows with equal features share leaves
+    leaves2 = forest.predict(np.vstack([X[0], X[0]]), pred_leaf=True)
+    assert (leaves2[0] == leaves2[1]).all()

@@ -89,6 +89,7 @@ class TrainConfig:
         self.subsample = float(p.get("subsample", 1.0))
         self.colsample_bytree = float(p.get("colsample_bytree", 1.0))
         self.colsample_bylevel = float(p.get("colsample_bylevel", 1.0))
+        self.colsample_bynode = float(p.get("colsample_bynode", 1.0))
         self.seed = int(p.get("seed", 0))
         self.objective = p.get("objective", "reg:squarederror")
         self.num_class = int(p.get("num_class", 0) or 0)
@@ -227,6 +228,7 @@ class _TrainingSession:
         if self.has_feature_axis and (
             config.colsample_bytree < 1.0
             or config.colsample_bylevel < 1.0
+            or config.colsample_bynode < 1.0
             or config.monotone_constraints
             or config.interaction_constraints
             or config.grow_policy == "lossguide"

@@ -45,6 +45,7 @@ def build_tree_lossguide(
     axis_name=None,
     rng=None,
     colsample_bylevel=1.0,
+    colsample_bynode=1.0,
     interaction_sets=None,
     feature_axis_name=None,
 ):
@@ -91,7 +92,7 @@ def build_tree_lossguide(
 
     node_of_row = jnp.zeros(n, jnp.int32)
 
-    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab):
+    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab, mask=None):
         """Histogram the two fresh children and return their candidates.
 
         parent_rows_mask_nodes: node_local [n] mapping rows to {0,1,-1}.
@@ -107,7 +108,7 @@ def build_tree_lossguide(
             alpha=alpha,
             gamma=gamma,
             min_child_weight=min_child_weight,
-            feature_mask=feature_mask,
+            feature_mask=mask if mask is not None else feature_mask,
             monotone=monotone,
         )
         # depth cap: children at depth_cap can never split
@@ -173,8 +174,13 @@ def build_tree_lossguide(
             0,
             jnp.where(can & (node_of_row == id_b), 1, -1),
         )
+        node_mask = feature_mask
+        if colsample_bynode < 1.0 and rng is not None:
+            draw = jax.random.uniform(jax.random.fold_in(rng, 7919 + t), (2, d))
+            sampled = (draw < colsample_bynode).astype(jnp.float32)
+            node_mask = sampled if node_mask is None else sampled * node_mask[None, :]
         splits, child_gains = _score_children(
-            child_local, id_a, id_b, jnp.stack([depth_ab, depth_ab])
+            child_local, id_a, id_b, jnp.stack([depth_ab, depth_ab]), node_mask
         )
         valid = can
         cand["gain"] = cand["gain"].at[id_a].set(jnp.where(valid, child_gains[0], -jnp.inf))

@@ -44,6 +44,7 @@ def build_tree(
     axis_name=None,
     rng=None,
     colsample_bylevel=1.0,
+    colsample_bynode=1.0,
     interaction_sets=None,
     feature_axis_name=None,
 ):
@@ -110,6 +111,19 @@ def build_tree(
             draw = jax.random.uniform(jax.random.fold_in(rng, level), (d,))
             sampled = (draw < colsample_bylevel).astype(jnp.float32)
             level_mask = sampled if level_mask is None else level_mask * sampled
+        if colsample_bynode < 1.0 and rng is not None:
+            # fresh per-node feature subset (xgboost colsample_bynode);
+            # same rng on every shard -> identical draws everywhere
+            node_draw = jax.random.uniform(
+                jax.random.fold_in(rng, 7919 + level), (width, d)
+            )
+            node_mask = (node_draw < colsample_bynode).astype(jnp.float32)
+            if level_mask is None:
+                level_mask = node_mask
+            elif level_mask.ndim == 1:
+                level_mask = node_mask * level_mask[None, :]
+            else:
+                level_mask = node_mask * level_mask
         if alive_sets is not None:
             # [W, S] @ [S, d] -> per-node allowed-feature mask
             node_allowed = (

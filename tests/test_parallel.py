@@ -387,3 +387,15 @@ def test_mesh_k_batching_no_evals(mesh8):
     np.testing.assert_allclose(
         forest.predict(X), single.predict(X), rtol=1e-4, atol=1e-4
     )
+
+
+def test_colsample_bynode_still_learns():
+    X, y = _friedman(900)
+    dtrain = DataMatrix(X, labels=y)
+    for extra in ({}, {"grow_policy": "lossguide", "max_leaves": 16, "max_depth": 0}):
+        params = {"max_depth": 4, "colsample_bynode": 0.6, "seed": 13}
+        params.update(extra)
+        forest = train(params, dtrain, num_boost_round=20)
+        base = float(np.sqrt(np.mean((y - y.mean()) ** 2)))
+        rmse = eval_metric("rmse", forest.predict(X), y)
+        assert rmse < 0.35 * base, (extra, rmse, base)

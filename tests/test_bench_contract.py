@@ -1,0 +1,52 @@
+"""bench.py driver contract: exactly one JSON line, under all conditions."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    return json.loads(lines[0])
+
+
+def test_bench_emits_single_json_line_cpu():
+    doc = _run(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_ROWS": "5000",
+            "BENCH_MAX_DEPTH": "3",
+            "BENCH_ROUNDS_N": "4",
+            "BENCH_ROUNDS_PER_DISPATCH": "2",
+            "BENCH_TIMEOUT_S": "240",
+        }
+    )
+    assert doc["unit"] == "rounds/sec"
+    assert doc["value"] > 0
+    assert "vs_baseline" in doc
+
+
+def test_bench_timeout_fallback_line():
+    doc = _run(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_ROWS": "200000",
+            "BENCH_TIMEOUT_S": "2",
+        },
+        timeout=120,
+    )
+    assert doc["value"] == 0.0
+    assert "FAILED" in doc["metric"]

@@ -3,26 +3,51 @@
 The hot op of the whole framework: for each tree level, accumulate
 (grad, hess) into a (node x feature x bin) tensor. This replaces libxgboost's
 OpenMP hist builder + Rabit allreduce (reference hot loop at
-algorithm_mode/train.py:367-376 -> C++): here it is a single
-``jax.ops.segment_sum`` over a flattened (node, feature, bin) index — XLA
-lowers it to a sorted scatter-add — followed by an optional ``lax.psum`` over
-the data-parallel mesh axis, which is the entire multi-host story (SURVEY.md
-§2.3 row 1).
+algorithm_mode/train.py:367-376 -> C++), followed by an optional
+``lax.psum`` over the data-parallel mesh axis, which is the entire
+multi-host story (SURVEY.md §2.3 row 1).
 
-Index layout: seg = (node_local * d + feature) * B + bin, with one extra
-trash segment for rows whose node is already finalized (node_local < 0).
+Four interchangeable implementations (``GRAFT_HIST_IMPL``), A/B-able on
+hardware without code changes:
+
+* ``flat`` (default): one ``jax.ops.segment_sum`` over n*d flattened
+  (node, feature, bin) ids. XLA lowers it to a sorted scatter-add —
+  correct everywhere, fast on CPU, scatter-bound on TPU.
+* ``per_feature``: d segment_sums over n with (node, bin) ids — smaller key
+  space per sort, no [n, d] id materialization.
+* ``matmul``: one-hot matmul formulation for the MXU — histograms become
+  [2W, chunk] @ [chunk, B] dots (grad/hess stacked along the node axis),
+  scanned over row chunks. No scatter at all; bandwidth-bound on the
+  materialized bin one-hots.
+* ``pallas``: the matmul formulation as a Pallas TPU kernel — per-block bin
+  one-hots live only in VMEM (never HBM), accumulator resident in VMEM
+  across the row-block grid. Compute-bound; bf16x2 split-precision operands
+  (hi/lo decomposition of f32 grads) keep MXU rate with ~f16-mantissa
+  accuracy, accumulated in f32.
 """
 
+import functools
 import os
 
 import jax
 import jax.numpy as jnp
 
-# "flat" (default): one segment_sum over n*d flattened (node,feature,bin) ids.
-# "per_feature": d segment_sums over n with (node,bin) ids — smaller key
-# space per sort, no [n, d] id materialization; A/B-able on hardware without
-# code changes.
-HIST_IMPL = os.environ.get("GRAFT_HIST_IMPL", "flat")
+
+def _impl():
+    return os.environ.get("GRAFT_HIST_IMPL", "flat")
+
+
+def _matmul_chunk():
+    return int(os.environ.get("GRAFT_HIST_CHUNK", 65536))
+
+
+def _pallas_block():
+    return int(os.environ.get("GRAFT_HIST_BLOCK", 512))
+
+
+def _matmul_precision():
+    """f32 | bf16x2 | bf16 for matmul/pallas operand precision."""
+    return os.environ.get("GRAFT_HIST_MM_PREC", "bf16x2")
 
 
 def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name=None):
@@ -40,27 +65,54 @@ def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name
     Returns:
       (G, H): f32 [num_nodes, d, num_bins].
     """
+    impl = _impl()
+    if impl == "per_feature":
+        G, H = _hist_per_feature(bins, grad, hess, node_local, num_nodes, num_bins)
+    elif impl == "matmul":
+        G, H = _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins)
+    elif impl == "pallas":
+        G, H = _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins)
+    elif impl == "flat":
+        G, H = _hist_flat(bins, grad, hess, node_local, num_nodes, num_bins)
+    else:
+        raise ValueError(
+            "Unknown GRAFT_HIST_IMPL=%r; expected flat|per_feature|matmul|pallas"
+            % impl
+        )
+    if axis_name is not None:
+        G = jax.lax.psum(G, axis_name)
+        H = jax.lax.psum(H, axis_name)
+    return G, H
+
+
+def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
+    """Per-node (sum g, sum h) without the full histogram.
+
+    The last tree level only needs leaf weights -> node totals; skipping the
+    [W, d, B] histogram there removes the widest (most expensive) level from
+    every tree build.
+    """
+    active = node_local >= 0
+    safe = jnp.where(active, node_local, num_nodes)
+    g_tot = jax.ops.segment_sum(
+        jnp.where(active, grad, 0.0), safe, num_segments=num_nodes + 1
+    )[:num_nodes]
+    h_tot = jax.ops.segment_sum(
+        jnp.where(active, hess, 0.0), safe, num_segments=num_nodes + 1
+    )[:num_nodes]
+    if axis_name is not None:
+        g_tot = jax.lax.psum(g_tot, axis_name)
+        h_tot = jax.lax.psum(h_tot, axis_name)
+    return g_tot, h_tot
+
+
+# --------------------------------------------------------------------- flat
+
+
+def _hist_flat(bins, grad, hess, node_local, num_nodes, num_bins):
     n, d = bins.shape
     active = node_local >= 0
-    # inactive rows land in the trailing trash segment
     safe_node = jnp.where(active, node_local, num_nodes)
-
-    if HIST_IMPL == "per_feature":
-        seg_base = safe_node * num_bins            # [n]
-        trash = num_nodes * num_bins
-        num_segments = trash + 1
-        Gs, Hs = [], []
-        for f in range(d):
-            seg_f = jnp.where(active, seg_base + bins[:, f], trash)
-            Gs.append(jax.ops.segment_sum(grad, seg_f, num_segments=num_segments)[:-1])
-            Hs.append(jax.ops.segment_sum(hess, seg_f, num_segments=num_segments)[:-1])
-        G = jnp.stack(Gs, axis=1).reshape(num_nodes, num_bins, d).transpose(0, 2, 1)
-        H = jnp.stack(Hs, axis=1).reshape(num_nodes, num_bins, d).transpose(0, 2, 1)
-        if axis_name is not None:
-            G = jax.lax.psum(G, axis_name)
-            H = jax.lax.psum(H, axis_name)
-        return G, H
-
     seg = (safe_node[:, None] * d + jnp.arange(d, dtype=jnp.int32)[None, :]) * num_bins + bins
     seg = jnp.where(active[:, None], seg, num_nodes * d * num_bins)
     num_segments = num_nodes * d * num_bins + 1
@@ -75,7 +127,215 @@ def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name
     H = jax.ops.segment_sum(h_flat, flat_seg, num_segments=num_segments)
     G = G[:-1].reshape(num_nodes, d, num_bins)
     H = H[:-1].reshape(num_nodes, d, num_bins)
-    if axis_name is not None:
-        G = jax.lax.psum(G, axis_name)
-        H = jax.lax.psum(H, axis_name)
     return G, H
+
+
+# -------------------------------------------------------------- per_feature
+
+
+def _hist_per_feature(bins, grad, hess, node_local, num_nodes, num_bins):
+    n, d = bins.shape
+    active = node_local >= 0
+    safe_node = jnp.where(active, node_local, num_nodes)
+    seg_base = safe_node * num_bins            # [n]
+    trash = num_nodes * num_bins
+    num_segments = trash + 1
+    Gs, Hs = [], []
+    for f in range(d):
+        seg_f = jnp.where(active, seg_base + bins[:, f], trash)
+        Gs.append(jax.ops.segment_sum(grad, seg_f, num_segments=num_segments)[:-1])
+        Hs.append(jax.ops.segment_sum(hess, seg_f, num_segments=num_segments)[:-1])
+    G = jnp.stack(Gs, axis=1).reshape(num_nodes, num_bins, d).transpose(0, 2, 1)
+    H = jnp.stack(Hs, axis=1).reshape(num_nodes, num_bins, d).transpose(0, 2, 1)
+    return G, H
+
+
+# ------------------------------------------------------------------- matmul
+
+
+def _split_bf16(x):
+    """f32 -> (hi, lo) bf16 pair with hi + lo ~= x to ~16 mantissa bits."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
+    """One-hot matmul histogram, scanned over row chunks.
+
+    Per chunk: A[c, 2W] = node-one-hot * (grad | hess); per feature,
+    P[2W, B] = A^T @ bin-one-hot[c, B]; accumulate into [2W, d, B] f32.
+    The MXU does the binning — no scatter anywhere.
+    """
+    n, d = bins.shape
+    W = num_nodes
+    B = num_bins
+    prec = _matmul_precision()
+
+    active = node_local >= 0
+    g = jnp.where(active, grad, 0.0)
+    h = jnp.where(active, hess, 0.0)
+    node = jnp.where(active, node_local, W)  # W = dead slot, one-hot -> 0
+
+    # balanced chunks: cap padding waste at steps-1 rows instead of a nearly
+    # full chunk when n slightly exceeds a multiple of the configured size
+    steps_wanted = -(-n // min(_matmul_chunk(), max(n, 1)))
+    chunk = -(-n // steps_wanted)
+    n_pad = -(-n // chunk) * chunk
+    if n_pad != n:
+        pad = [(0, n_pad - n)]
+        g = jnp.pad(g, pad)
+        h = jnp.pad(h, pad)
+        node = jnp.pad(node, pad, constant_values=W)
+        bins = jnp.pad(bins, pad + [(0, 0)])
+    steps = n_pad // chunk
+
+    iota_w = jnp.arange(W, dtype=jnp.int32)
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+
+    def body(carry, i):
+        GH = carry
+        sl = i * chunk
+        node_c = jax.lax.dynamic_slice(node, (sl,), (chunk,))
+        g_c = jax.lax.dynamic_slice(g, (sl,), (chunk,))
+        h_c = jax.lax.dynamic_slice(h, (sl,), (chunk,))
+        bins_c = jax.lax.dynamic_slice(bins, (sl, 0), (chunk, d))
+        onehot_w = (node_c[:, None] == iota_w[None, :]).astype(jnp.float32)
+        A = jnp.concatenate(
+            [onehot_w * g_c[:, None], onehot_w * h_c[:, None]], axis=1
+        )  # [c, 2W]
+        per_f = []
+        for f in range(d):
+            Ob32 = (bins_c[:, f][:, None] == iota_b[None, :]).astype(jnp.float32)
+            if prec == "f32":
+                P = jax.lax.dot_general(
+                    A, Ob32, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            elif prec == "bf16":
+                P = jax.lax.dot_general(
+                    A.astype(jnp.bfloat16), Ob32.astype(jnp.bfloat16),
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            else:  # bf16x2
+                Ob = Ob32.astype(jnp.bfloat16)
+                hi, lo = _split_bf16(A)
+                P = jax.lax.dot_general(
+                    hi, Ob, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) + jax.lax.dot_general(
+                    lo, Ob, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            per_f.append(P)
+        GH = GH + jnp.stack(per_f, axis=1)  # [2W, d, B]
+        return GH, None
+
+    init = jnp.zeros((2 * W, d, B), jnp.float32)
+    if steps == 1:
+        GH, _ = body(init, jnp.int32(0))
+    else:
+        GH, _ = jax.lax.scan(body, init, jnp.arange(steps, dtype=jnp.int32))
+    return GH[:W], GH[W:]
+
+
+# ------------------------------------------------------------------- pallas
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_hist_fn(n, d, W, B, block, prec, interpret):
+    """Compiled pallas histogram: (bins i32 [n,d], gh f32 [n,2], node i32 [n,1])
+    -> [2W, d, B] f32. Grid over row blocks; VMEM-resident accumulator."""
+    import jax.experimental.pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        vmem = pltpu.VMEM
+    except ImportError:  # pragma: no cover
+        pltpu = None
+        vmem = None
+
+    def kernel(bins_ref, gh_ref, node_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        node = node_ref[:, 0]                          # [blk]
+        onehot_w = (node[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (block, W), 1)).astype(jnp.float32)
+        g = gh_ref[:, 0]
+        h = gh_ref[:, 1]
+        A = jnp.concatenate(
+            [onehot_w * g[:, None], onehot_w * h[:, None]], axis=1
+        )  # [blk, 2W]
+        if prec == "bf16x2":
+            A_hi, A_lo = _split_bf16(A)
+        elif prec == "bf16":
+            A_hi = A.astype(jnp.bfloat16)
+            A_lo = None
+        else:
+            A_hi, A_lo = A, None
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (block, B), 1)
+        for f in range(d):
+            ob = (bins_ref[:, f][:, None] == iota_b)
+            ob = ob.astype(A_hi.dtype)
+            P = jax.lax.dot_general(
+                A_hi, ob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if A_lo is not None:
+                P = P + jax.lax.dot_general(
+                    A_lo, ob.astype(A_lo.dtype), (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            out_ref[:, f, :] += P
+
+    steps = n // block
+    if vmem is not None and not interpret:
+        in_space = dict(memory_space=vmem)
+    else:
+        in_space = {}
+
+    return pl.pallas_call(
+        kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0), **in_space),
+            pl.BlockSpec((block, 2), lambda i: (i, 0), **in_space),
+            pl.BlockSpec((block, 1), lambda i: (i, 0), **in_space),
+        ],
+        out_specs=pl.BlockSpec((2 * W, d, B), lambda i: (0, 0, 0), **in_space),
+        out_shape=jax.ShapeDtypeStruct((2 * W, d, B), jnp.float32),
+        interpret=interpret,
+    )
+
+
+def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
+    n, d = bins.shape
+    W = num_nodes
+    B = num_bins
+    block = _pallas_block()
+    prec = _matmul_precision()
+    interpret = jax.default_backend() != "tpu"
+
+    active = node_local >= 0
+    g = jnp.where(active, grad, 0.0)
+    h = jnp.where(active, hess, 0.0)
+    node = jnp.where(active, node_local, jnp.int32(W))
+
+    n_pad = -(-n // block) * block
+    if n_pad != n:
+        pad = [(0, n_pad - n)]
+        g = jnp.pad(g, pad)
+        h = jnp.pad(h, pad)
+        node = jnp.pad(node, pad, constant_values=W)
+        bins = jnp.pad(bins, pad + [(0, 0)])
+
+    gh = jnp.stack([g, h], axis=1)                     # [n, 2]
+    fn = _pallas_hist_fn(n_pad, d, W, B, block, prec, interpret)
+    GH = fn(bins.astype(jnp.int32), gh, node[:, None].astype(jnp.int32))
+    return GH[:W], GH[W:]

@@ -13,10 +13,12 @@ finalized rows hold -1 and accumulate their leaf value into ``row_out``, so
 the booster updates margins without re-predicting the train set.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
-from .histogram import level_histogram
+from .histogram import level_histogram, node_totals
 from .split import find_best_splits, leaf_weight
 
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
@@ -24,6 +26,20 @@ MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
 
 def max_nodes_for_depth(max_depth):
     return 2 ** (max_depth + 1) - 1
+
+
+def _subtraction_enabled(max_depth, d, num_bins):
+    """Histogram subtraction: build only left children, derive right ones as
+    parent - left (libxgboost's standard sibling trick) — halves histogram
+    work per level. Needs the previous level's histograms cached
+    ([2**(L-1), d, B] f32 x2); gated by a memory cap for very deep trees."""
+    if os.environ.get("GRAFT_HIST_SUBTRACT", "1") != "1":
+        return False
+    if max_depth < 2:
+        return False
+    cache_bytes = 2 * (2 ** (max_depth - 1)) * d * num_bins * 4
+    cap = int(os.environ.get("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024))
+    return cache_bytes <= cap
 
 
 def build_tree(
@@ -96,14 +112,60 @@ def build_tree(
         jax.lax.axis_index(feature_axis_name) if feature_axis_name is not None else None
     )
 
+    subtract = _subtraction_enabled(max_depth, d, num_bins)
+    G_cache = H_cache = None      # previous level's [W/2, d, B] histograms
+    parent_leaf = None            # previous level's becomes_leaf [W/2]
+
     for level in range(max_depth + 1):
         first = 2**level - 1
         width = 2**level
         node_local = node_of_row - first  # negative for finalized rows
 
-        G, H = level_histogram(
-            bins, grad, hess, node_local, width, num_bins, axis_name=axis_name
-        )
+        if level == max_depth:
+            # Last level: every surviving node becomes a leaf, and leaf
+            # weights only need per-node g/h totals — skip the full (widest,
+            # most expensive) [W, d, B] histogram of the tree entirely.
+            g_tot, h_tot = node_totals(
+                grad, hess, node_local, width, axis_name=axis_name
+            )
+            weight = leaf_weight(
+                g_tot, h_tot,
+                reg_lambda=reg_lambda, alpha=alpha, max_delta_step=max_delta_step,
+            )
+            sl = slice(first, first + width)
+            tree["is_leaf"] = tree["is_leaf"].at[sl].set(True)
+            tree["leaf_value"] = tree["leaf_value"].at[sl].set(eta * weight)
+            tree["base_weight"] = tree["base_weight"].at[sl].set(weight)
+            tree["sum_hess"] = tree["sum_hess"].at[sl].set(h_tot)
+            at_level = node_local >= 0
+            local_safe = jnp.clip(node_local, 0, width - 1)
+            row_out = jnp.where(at_level, eta * weight[local_safe], row_out)
+            break
+
+        if subtract and level > 0:
+            # histogram only the LEFT child of each sibling pair; the right
+            # one is parent - left. Parents that leafed routed no rows to
+            # their children, so their pair contribution is zeroed.
+            active = node_local >= 0
+            is_left = (node_local % 2) == 0
+            left_local = jnp.where(active & is_left, node_local // 2, -1)
+            Gl, Hl = level_histogram(
+                bins, grad, hess, left_local, width // 2, num_bins,
+                axis_name=axis_name,
+            )
+            keep = ~parent_leaf
+            Gp = jnp.where(keep[:, None, None], G_cache, 0.0)
+            Hp = jnp.where(keep[:, None, None], H_cache, 0.0)
+            Gr = Gp - Gl
+            Hr = Hp - Hl
+            G = jnp.stack([Gl, Gr], axis=1).reshape(width, d, -1)
+            H = jnp.stack([Hl, Hr], axis=1).reshape(width, d, -1)
+        else:
+            G, H = level_histogram(
+                bins, grad, hess, node_local, width, num_bins, axis_name=axis_name
+            )
+        if subtract:
+            G_cache, H_cache = G, H
         level_mask = feature_mask
         if colsample_bylevel < 1.0 and rng is not None:
             # fresh feature subset per level; identical on all shards (rng is
@@ -173,11 +235,9 @@ def build_tree(
             g_tot, h_tot, reg_lambda=reg_lambda, alpha=alpha, max_delta_step=max_delta_step
         )
 
-        if level == max_depth:
-            can_split = jnp.zeros(width, jnp.bool_)
-        else:
-            can_split = splits["gain"] > MIN_SPLIT_LOSS
+        can_split = splits["gain"] > MIN_SPLIT_LOSS
         becomes_leaf = ~can_split
+        parent_leaf = becomes_leaf
 
         sl = slice(first, first + width)
         tree["feature"] = tree["feature"].at[sl].set(splits["feature"])

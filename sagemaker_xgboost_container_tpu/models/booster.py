@@ -248,11 +248,6 @@ class _TrainingSession:
         self.objective.validate_labels(labels)
 
         self.is_ranking = getattr(self.objective, "needs_groups", False)
-        if self.is_ranking and mesh is not None:
-            raise exc.UserError(
-                "Distributed training for ranking objectives is not supported yet; "
-                "run ranking jobs on a single host."
-            )
         if self.objective.name == "survival:cox" and mesh is not None:
             # Cox risk sets span the whole dataset; shard-local
             # argsort/cumsum would silently compute wrong gradients
@@ -260,15 +255,55 @@ class _TrainingSession:
                 "Distributed training for survival:cox is not supported yet; "
                 "run Cox regression jobs on a single host."
             )
+        # ranking layouts: single device keeps the [G, M] global layout;
+        # on a mesh, rows are re-partitioned BY GROUP (groups never straddle
+        # shards, so intra-group pairwise gradients stay shard-exact — the
+        # reference's Rabit ranking path keeps worker groups whole the same
+        # way, hyperparameter_validation.py:283-309 trains them under Rabit)
+        self.row_index = None
+        self.rank_perm = None          # device-order position -> original row
+        self.rank_pos = None           # original (local) row -> device position
+        self._rank_index_np = None     # [local_shards, G_max, M]
         if self.is_ranking:
+            if self.has_feature_axis:
+                raise exc.UserError(
+                    "Ranking objectives with feature-axis sharding are not "
+                    "supported yet"
+                )
             if dtrain.groups is None:
                 # xgboost convention: absent group info = one group per dataset
                 groups = np.asarray([dtrain.num_row], np.int64)
             else:
-                groups = dtrain.groups
-            self.row_index = jnp.asarray(build_group_layout(groups))
-        else:
-            self.row_index = None
+                groups = np.asarray(dtrain.groups, np.int64)
+            if mesh is None:
+                self.row_index = jnp.asarray(build_group_layout(groups))
+            else:
+                from ..ops.ranking import build_sharded_group_layout
+
+                local_shards = max(1, len(mesh.local_devices)) if self.is_multiprocess else self.n_data_shards
+                perm, ri, rps = build_sharded_group_layout(groups, local_shards)
+                if self.is_multiprocess:
+                    # all hosts must agree on padded shapes
+                    from jax.experimental import multihost_utils
+
+                    maxima = np.asarray(
+                        multihost_utils.process_allgather(
+                            np.asarray([rps, ri.shape[1], ri.shape[2]], np.int64)
+                        )
+                    ).max(axis=0)
+                    perm, ri, rps = build_sharded_group_layout(
+                        groups,
+                        local_shards,
+                        rows_per_shard=int(maxima[0]),
+                        max_groups_per_shard=int(maxima[1]),
+                        max_group_size=int(maxima[2]),
+                    )
+                self.rank_perm = perm
+                self._rank_index_np = ri
+                pos = np.full(dtrain.num_row, -1, np.int64)
+                m = perm >= 0
+                pos[perm[m]] = np.nonzero(m)[0]
+                self.rank_pos = pos
 
         shared_cuts = None
         if self.is_multiprocess:
@@ -290,7 +325,20 @@ class _TrainingSession:
             self.eval_sets.append((name, dm, binned))
 
         self.n = dtrain.num_row
-        n_pad = -(-self.n // self.pad_unit) * self.pad_unit
+        if self.rank_perm is not None:
+            n_pad = len(self.rank_perm)   # local_shards * rows_per_shard
+        else:
+            n_pad = -(-self.n // self.pad_unit) * self.pad_unit
+
+        def _layout_rows(arr, fill):
+            """Original-order rows -> device layout (tail padding, or the
+            group-partitioned permutation for distributed ranking)."""
+            if self.rank_perm is None:
+                return _pad_rows(arr, n_pad, fill)
+            out = np.full((n_pad,) + arr.shape[1:], fill, arr.dtype)
+            m = self.rank_perm >= 0
+            out[m] = arr[self.rank_perm[m]]
+            return out
 
         # column padding: features pad to a multiple of the feature shards
         # with always-missing columns (zero cuts -> never split on)
@@ -320,7 +368,7 @@ class _TrainingSession:
         self.feat_spec = P("feature") if self.has_feature_axis else P()
         margin_spec = P("data") if self.num_group == 1 else P("data", None)
 
-        bins_np = _pad_rows(self.train_binned.bins, n_pad, self.train_binned.max_bin)
+        bins_np = _layout_rows(self.train_binned.bins, self.train_binned.max_bin)
         if d_pad != d_real:
             bins_np = np.concatenate(
                 [
@@ -335,9 +383,15 @@ class _TrainingSession:
             )
         self.num_cuts = _put(num_cuts_np, self.feat_spec)
         self.bins = _put(bins_np, self.bins_spec)
-        self.labels = _put(_pad_rows(labels, n_pad, 0.0), P("data"))
-        self.weights = _put(_pad_rows(dtrain.get_weight(), n_pad, 0.0), P("data"))
+        self.labels = _put(_layout_rows(labels, 0.0), P("data"))
+        self.weights = _put(_layout_rows(dtrain.get_weight(), 0.0), P("data"))
         self.groups = dtrain.groups
+        if self._rank_index_np is not None:
+            self.rank_index_dev = _put(self._rank_index_np, P("data", None, None))
+        elif self.row_index is not None:
+            self.rank_index_dev = self.row_index
+        else:
+            self.rank_index_dev = jnp.zeros((1, 1), jnp.int32)  # inert dummy
 
         base = self.objective.base_margin(forest.base_score)
         shape = (n_pad,) if self.num_group == 1 else (n_pad, self.num_group)
@@ -346,7 +400,7 @@ class _TrainingSession:
                 (self.n,) if self.num_group == 1 else (self.n, self.num_group)
             )
             self.margins = _put(
-                _pad_rows(margin.astype(np.float32), n_pad, base), margin_spec
+                _layout_rows(margin.astype(np.float32), base), margin_spec
             )
         else:
             self.margins = _put(np.full(shape, base, np.float32), margin_spec)
@@ -387,25 +441,46 @@ class _TrainingSession:
 
         self.rounds_per_dispatch = max(1, config.rounds_per_dispatch)
         self.device_metric_fns = None
-        if self.rounds_per_dispatch > 1 and self.eval_sets:
-            # batching stays possible when every watched metric computes on
-            # device: per-round scalars (for every eval set) ride back with
-            # the batch (device_metrics.py). Mesh runs keep K=1: nonlinear
-            # metrics (rmse/rmsle) don't combine exactly from per-shard means.
-            if not self.is_ranking and metric_names and not has_feval and mesh is None:
-                from .device_metrics import all_supported
+        # Device metrics decompose into psum-able partial stats
+        # (device_metrics.py), so they work on any mesh: K-round batching
+        # psums per-round stat vectors over the "data" axis inside the
+        # jitted scan, and multi-process runs get globally exact metric
+        # lines (reference semantics: metrics allreduced under the
+        # communicator, distributed.py:219). They activate when batching is
+        # requested (K > 1) or when multi-process exactness needs them.
+        want_device_metrics = (
+            self.eval_sets
+            and metric_names
+            and not has_feval
+            and not self.is_ranking
+            and (self.rounds_per_dispatch > 1 or self.is_multiprocess)
+        )
+        if want_device_metrics:
+            from .device_metrics import all_supported
 
-                self.device_metric_fns = all_supported(
-                    metric_names, self.objective.name, self.num_group
-                )
-            if self.device_metric_fns is None:
-                logger.warning(
-                    "_rounds_per_dispatch > 1 needs device-computable per-round "
-                    "eval metrics; falling back to 1."
-                )
-                self.rounds_per_dispatch = 1
-            else:
+            self.device_metric_fns = all_supported(
+                metric_names,
+                self.objective.name,
+                self.num_group,
+                config.objective_params,
+            )
+            if self.device_metric_fns is not None:
                 self.device_metric_names = list(metric_names)
+        if (
+            self.rounds_per_dispatch > 1
+            and self.eval_sets
+            and self.device_metric_fns is None
+        ):
+            logger.warning(
+                "_rounds_per_dispatch > 1 needs device-computable per-round "
+                "eval metrics; falling back to 1."
+            )
+            self.rounds_per_dispatch = 1
+        # the lax.scan round path carries eval margins + metric stats on
+        # device; used for K > 1 and for exact multi-process evaluation
+        self.use_scan_rounds = self.rounds_per_dispatch > 1 or (
+            self.device_metric_fns is not None and self.is_multiprocess
+        )
 
         monotone = np.zeros(self.d_pad, np.int32)
         if config.monotone_constraints:
@@ -422,11 +497,13 @@ class _TrainingSession:
         if not self.is_ranking:
             return None
         scheme = self.objective.scheme
-        row_index = self.row_index
 
-        def ranking_grads(margins, labels, weights):
+        def ranking_grads(margins, labels, weights, rank_index):
+            if rank_index.ndim == 3:
+                # per-shard [1, G_max, M] slice under shard_map
+                rank_index = rank_index.reshape(rank_index.shape[1:])
             return lambdarank_grad_hess(
-                margins, labels, weights, row_index, scheme=scheme
+                margins, labels, weights, rank_index, scheme=scheme
             )
 
         return ranking_grads
@@ -481,13 +558,22 @@ class _TrainingSession:
         num_parallel = cfg.num_parallel_tree
         use_monotone = self.has_monotone
 
-        def one_round(bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone):
+        def one_round(
+            bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone,
+            rank_index,
+        ):
             mono = monotone if use_monotone else None
+            # Two rng streams: the replicated one drives feature-subset draws
+            # inside build_tree (colsample_bylevel/bynode), which MUST be
+            # identical on every shard so all shards pick the same splits;
+            # the shard-folded one drives row subsampling, which must be
+            # decorrelated per shard (each shard owns different rows).
             if axis_name is not None:
-                # decorrelate per-shard subsample draws
-                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+                shard_rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+            else:
+                shard_rng = rng
             if ranking_grads is not None:
-                g, h = ranking_grads(margins, labels, weights)
+                g, h = ranking_grads(margins, labels, weights, rank_index)
             else:
                 g, h = grad_hess(margins, labels, weights)
 
@@ -506,7 +592,7 @@ class _TrainingSession:
                 total_out = jnp.zeros_like(margins)
                 for k in range(num_parallel):
                     rng_k = jax.random.fold_in(rng, k)
-                    gk, hk = sampled(rng_k, g, h)
+                    gk, hk = sampled(jax.random.fold_in(shard_rng, k), g, h)
                     tree, row_out = builder(
                         bins, gk, hk, num_cuts,
                         feature_mask=feature_mask, monotone=mono, rng=rng_k,
@@ -516,7 +602,7 @@ class _TrainingSession:
                 margins = margins + total_out
             else:
                 rng_k = jax.random.fold_in(rng, 0)
-                g, h = sampled(rng_k, g, h)
+                g, h = sampled(jax.random.fold_in(shard_rng, 0), g, h)
                 tree, row_out = jax.vmap(
                     lambda gc, hc: builder(
                         bins, gc, hc, num_cuts,
@@ -537,14 +623,15 @@ class _TrainingSession:
 
         metric_fns = self.device_metric_fns
         shared_flags = [b is None for b in self.eval_bins]
-        eval_bins_ns = [b for b in self.eval_bins if b is not None]
-        eval_labels = list(self.eval_labels)
-        eval_weights = list(self.eval_weights)
         predict_depth = cfg.predict_depth
 
         def multi_round(
-            bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone, eval_m
+            bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone,
+            rank_index, eval_m, eval_blw,
         ):
+            # eval_blw: ((bins, labels, weights), ...) for the non-shared
+            # eval sets — passed as sharded args (closures would stay global
+            # under shard_map and mismatch the per-shard margins)
             # lax.scan so the round body is compiled ONCE regardless of K
             k_features = max(1, int(round(colsample * d)))
 
@@ -560,7 +647,8 @@ class _TrainingSession:
                 else:
                     mask = feature_mask
                 packed, margins_c = one_round(
-                    bins, margins_c, labels, weights, num_cuts, rng_j, mask, monotone
+                    bins, margins_c, labels, weights, num_cuts, rng_j, mask,
+                    monotone, rank_index,
                 )
                 if metric_fns:
                     new_extra = []
@@ -568,22 +656,29 @@ class _TrainingSession:
                     ei = 0
                     for si, shared in enumerate(shared_flags):
                         if shared:
-                            m_e = margins_c
+                            m_e, y_e, w_e = margins_c, labels, weights
                         else:
+                            b_e, y_e, w_e = eval_blw[ei]
                             m_e = _apply_packed_tree(
-                                packed, eval_bins_ns[ei], extra[ei],
+                                packed, b_e, extra[ei],
                                 num_group, num_parallel, predict_depth, num_bins,
                             )
                             new_extra.append(m_e)
                             ei += 1
-                        per_set.append(
-                            jnp.stack(
-                                [
-                                    fn(m_e, eval_labels[si], eval_weights[si])
-                                    for fn in metric_fns
-                                ]
-                            )
+                        # shard-local partial stats -> psum over the data
+                        # axis -> finalize: metric scalars are globally
+                        # exact and identical on every shard/host
+                        stats = jnp.concatenate(
+                            [fn.partial(m_e, y_e, w_e) for fn in metric_fns]
                         )
+                        if axis_name is not None:
+                            stats = jax.lax.psum(stats, axis_name)
+                        scalars_set = []
+                        off = 0
+                        for fn in metric_fns:
+                            scalars_set.append(fn.finalize(stats[off : off + fn.size]))
+                            off += fn.size
+                        per_set.append(jnp.stack(scalars_set))
                     scalars = jnp.stack(per_set)          # [n_sets, n_metrics]
                     extra = tuple(new_extra)
                 else:
@@ -597,13 +692,17 @@ class _TrainingSession:
             )
             return packed_all, metrics_all, margins, eval_m
 
-        fn = one_round if K == 1 else multi_round
+        use_scan = self.use_scan_rounds
+        fn = multi_round if use_scan else one_round
         if self.mesh is None:
-            if K == 1:
+            if not use_scan:
                 return jax.jit(fn, donate_argnums=(1,))
-            return jax.jit(fn, donate_argnums=(1, 8))
+            return jax.jit(fn, donate_argnums=(1, 9))
 
         margin_spec = P("data") if num_group == 1 else P("data", None)
+        rank_spec = (
+            P("data", None, None) if self._rank_index_np is not None else P()
+        )
         base_specs = (
             self.bins_spec,    # bins
             margin_spec,       # margins
@@ -613,8 +712,9 @@ class _TrainingSession:
             P(),               # rng
             self.feat_spec,    # feature_mask
             self.feat_spec,    # monotone
+            rank_spec,         # rank_index
         )
-        if K == 1:
+        if not use_scan:
             in_specs = base_specs
             out_specs = (P(), margin_spec)
             donate = (1,)
@@ -622,9 +722,14 @@ class _TrainingSession:
             eval_specs = tuple(
                 margin_spec for m in self.eval_margins if m is not None
             )
-            in_specs = base_specs + (eval_specs,)
+            eval_blw_specs = tuple(
+                (P("data", None), P("data"), P("data"))
+                for b in self.eval_bins
+                if b is not None
+            )
+            in_specs = base_specs + (eval_specs, eval_blw_specs)
             out_specs = (P(), P(), margin_spec, eval_specs)
-            donate = (1, 8)
+            donate = (1, 9)
         mapped = shard_map(
             fn,
             mesh=self.mesh,
@@ -690,8 +795,9 @@ class _TrainingSession:
             sub,
             feature_mask,
             self.monotone,
+            self.rank_index_dev,
         )
-        if self.rounds_per_dispatch == 1:
+        if not self.use_scan_rounds:
             packed, self.margins = self._round_fn(*args)
             for i in range(len(self.eval_sets)):
                 if self.eval_margins[i] is not None:
@@ -700,7 +806,14 @@ class _TrainingSession:
                     )
             return [unpack_tree(np.asarray(packed))], None
         eval_m = tuple(m for m in self.eval_margins if m is not None)
-        packed, metrics, self.margins, eval_m_out = self._round_fn(*args, eval_m)
+        eval_blw = tuple(
+            (self.eval_bins[i], self.eval_labels[i], self.eval_weights[i])
+            for i in range(len(self.eval_bins))
+            if self.eval_bins[i] is not None
+        )
+        packed, metrics, self.margins, eval_m_out = self._round_fn(
+            *args, eval_m, eval_blw
+        )
         ei = 0
         for i in range(len(self.eval_margins)):
             if self.eval_margins[i] is not None:
@@ -716,25 +829,40 @@ class _TrainingSession:
     # ----------------------------------------------------------------- eval
     def _to_host(self, arr, n_real):
         """Device margins -> host numpy. In multi-process mode this returns
-        the *local* shard's rows (each host evaluates its own data slice;
-        metric lines are per-host, matching how each host loaded only its own
-        channel shard)."""
+        the *local* shard's rows; ``evaluate`` then combines per-host values
+        into one global number (see its docstring)."""
         if self.is_multiprocess:
             shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
             local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
-            return local[:n_real]
-        return np.asarray(arr)[:n_real]
+            return local if n_real is None else local[:n_real]
+        full = np.asarray(arr)
+        return full if n_real is None else full[:n_real]
 
     def margins_for(self, index):
         dm = self.eval_sets[index][1]
         m = self.eval_margins[index]
         if m is None:
+            if self.rank_pos is not None:
+                # distributed-ranking layout: padding is interleaved per
+                # shard; map device positions back to original row order
+                full = self._to_host(self.margins, None)
+                return full[self.rank_pos]
             return self._to_host(self.margins, self.n)
         return self._to_host(m, dm.num_row)
 
     def evaluate(self, metric_names, feval=None):
-        """Returns list of (data_name, metric_name, value) per eval set."""
+        """Returns list of (data_name, metric_name, value) per eval set.
+
+        In multi-process runs each host computes on its local shard and the
+        values combine as a weight-sum-weighted mean across hosts, so every
+        host reports identical numbers (the path for metrics that cannot
+        decompose into device partials — ndcg/map/feval; decomposable ones
+        ride the exact device psum path instead). This mirrors distributed
+        xgboost, where python-side custom metrics are computed per worker
+        and averaged rather than allreduced elementwise.
+        """
         results = []
+        set_weight_sums = []
         for i, (name, dm, binned) in enumerate(self.eval_sets):
             margin = self.margins_for(i)
             preds = self.objective.margin_to_prediction(margin)
@@ -743,6 +871,8 @@ class _TrainingSession:
                 prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
                     self.objective, margin
                 )
+            w = dm.get_weight()
+            wsum = float(np.sum(w)) if w is not None else float(dm.num_row)
             for metric in metric_names:
                 value = eval_metrics.evaluate(
                     metric,
@@ -753,11 +883,30 @@ class _TrainingSession:
                     prob_matrix=prob_matrix,
                 )
                 results.append((name, metric, value))
+                set_weight_sums.append(wsum)
             if feval is not None:
                 # xgboost >= 1.2 convention: feval receives the raw margin
                 for metric_name, value in feval(margin, dm):
                     results.append((name, metric_name, value))
-        return results
+                    set_weight_sums.append(wsum)
+        if not self.is_multiprocess or not results:
+            return results
+        from jax.experimental import multihost_utils
+
+        vals = np.asarray([v for (_, _, v) in results], np.float64)
+        ws = np.asarray(set_weight_sums, np.float64)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                np.stack([vals * ws, ws], axis=1).astype(np.float32)
+            )
+        )  # [P, n_entries, 2]
+        combined = gathered[:, :, 0].sum(axis=0) / np.maximum(
+            gathered[:, :, 1].sum(axis=0), 1e-12
+        )
+        return [
+            (name, metric, float(combined[j]))
+            for j, (name, metric, _v) in enumerate(results)
+        ]
 
 
 def train(

@@ -1,18 +1,41 @@
-"""Device-side (jnp) eval metrics for batched-round dispatches.
+"""Device-side (jnp) eval metrics as psum-able partial statistics.
 
-When every watched metric is computable on device and all eval sets share the
-training margins (the default SageMaker watchlist is just "train"), boosting
-rounds batch K-at-a-time (`_rounds_per_dispatch`) and the per-round metric
-scalars come back as one [K, n_metrics] array — preserving the per-round HPO
-stdout contract without per-round host round-trips.
+Every metric decomposes into a fixed-size statistics vector that combines
+across data shards by plain summation (``lax.psum`` over the "data" mesh
+axis) plus a cheap ``finalize`` that turns combined stats into the scalar.
+This is what lets boosting rounds batch K-at-a-time (`_rounds_per_dispatch`)
+*on a mesh* and makes multi-host metric lines globally exact: the reference
+allreduces metrics inside xgb.train under the communicator
+(reference distributed.py:219), so every host prints the same value — here
+the psum of (numerator, denominator) pairs inside the jitted round does the
+same job.
 
 Weighted formulations throughout: padding rows carry weight 0, so they drop
 out of every metric automatically.
+
+AUC is the one metric that does not decompose exactly: following xgboost's
+own distributed semantics, each shard computes its local weighted
+Mann-Whitney AUC and shards combine as a weighted average with weight
+(local positive weight x local negative weight). Single-shard runs are
+exact.
 """
 
 import jax.numpy as jnp
 
 _EPS = 1e-15
+
+
+class DeviceMetric:
+    """A decomposable metric: ``partial`` -> psum-able f32 [size] -> ``finalize``."""
+
+    def __init__(self, name, size, partial, finalize):
+        self.name = name
+        self.size = size
+        self.partial = partial
+        self.finalize = finalize
+
+    def __call__(self, margins, labels, weights):
+        return self.finalize(self.partial(margins, labels, weights))
 
 
 def _sigmoid(m):
@@ -24,10 +47,6 @@ def _softmax(m):
     return e / e.sum(axis=1, keepdims=True)
 
 
-def _weighted_mean(values, w):
-    return jnp.sum(values * w) / jnp.maximum(jnp.sum(w), _EPS)
-
-
 def _prob_transform(objective_name, margins):
     if objective_name in ("reg:logistic", "binary:logistic"):
         return _sigmoid(margins)
@@ -36,73 +55,85 @@ def _prob_transform(objective_name, margins):
     return margins
 
 
+def _weighted_mean_metric(name, objective_name, term_fn, post=None):
+    """Metric = post(sum(w * term) / sum(w)); stats vector [num, den]."""
+
+    def partial(m, y, w):
+        p = _prob_transform(objective_name, m)
+        return jnp.stack([jnp.sum(term_fn(p, y, w) * w), jnp.sum(w)])
+
+    def finalize(stats):
+        mean = stats[0] / jnp.maximum(stats[1], _EPS)
+        return post(mean) if post is not None else mean
+
+    return DeviceMetric(name, 2, partial, finalize)
+
+
 def make_device_metric(name, objective_name, num_group=1, params=None):
-    """-> fn(margins, labels, weights) -> scalar, or None if unsupported."""
+    """-> DeviceMetric, or None if unsupported on device."""
     params = params or {}
     base, _, suffix = name.partition("@")
 
     if num_group > 1:
         if base == "merror":
-            def merror(m, y, w):
+            def term(m, y, w):
                 pred = jnp.argmax(m, axis=1)
-                return _weighted_mean((pred != y.astype(jnp.int32)).astype(jnp.float32), w)
+                return (pred != y.astype(jnp.int32)).astype(jnp.float32)
 
-            return merror
+            def partial(m, y, w):
+                return jnp.stack([jnp.sum(term(m, y, w) * w), jnp.sum(w)])
+
+            return DeviceMetric(name, 2, partial, lambda s: s[0] / jnp.maximum(s[1], _EPS))
         if base == "mlogloss":
-            def mlogloss(m, y, w):
+            def partial(m, y, w):
                 p = _softmax(m)
                 picked = jnp.take_along_axis(
                     p, y.astype(jnp.int32)[:, None], axis=1
                 )[:, 0]
-                return _weighted_mean(-jnp.log(jnp.clip(picked, _EPS, 1.0)), w)
+                v = -jnp.log(jnp.clip(picked, _EPS, 1.0))
+                return jnp.stack([jnp.sum(v * w), jnp.sum(w)])
 
-            return mlogloss
+            return DeviceMetric(name, 2, partial, lambda s: s[0] / jnp.maximum(s[1], _EPS))
         return None
 
-    def with_pred(fn):
-        def wrapped(m, y, w):
-            return fn(_prob_transform(objective_name, m), y, w)
-
-        return wrapped
+    wm = lambda term_fn, post=None: _weighted_mean_metric(  # noqa: E731
+        name, objective_name, term_fn, post
+    )
 
     if base == "rmse":
-        return with_pred(lambda p, y, w: jnp.sqrt(_weighted_mean((p - y) ** 2, w)))
+        return wm(lambda p, y, w: (p - y) ** 2, post=jnp.sqrt)
     if base == "mse":
-        return with_pred(lambda p, y, w: _weighted_mean((p - y) ** 2, w))
+        return wm(lambda p, y, w: (p - y) ** 2)
     if base == "mae":
-        return with_pred(lambda p, y, w: _weighted_mean(jnp.abs(p - y), w))
+        return wm(lambda p, y, w: jnp.abs(p - y))
     if base == "mape":
-        return with_pred(
-            lambda p, y, w: _weighted_mean(
-                jnp.abs((y - p) / jnp.maximum(jnp.abs(y), _EPS)), w
-            )
-        )
+        return wm(lambda p, y, w: jnp.abs((y - p) / jnp.maximum(jnp.abs(y), _EPS)))
     if base == "rmsle":
-        return with_pred(
-            lambda p, y, w: jnp.sqrt(
-                _weighted_mean((jnp.log1p(jnp.maximum(p, 0.0)) - jnp.log1p(y)) ** 2, w)
-            )
+        return wm(
+            lambda p, y, w: (jnp.log1p(jnp.maximum(p, 0.0)) - jnp.log1p(y)) ** 2,
+            post=jnp.sqrt,
         )
     if base == "logloss":
-        def logloss(p, y, w):
+        def term(p, y, w):
             # f32-safe: clip with an epsilon representable in float32
             eps32 = 1e-7
             p = jnp.clip(p, eps32, 1 - eps32)
-            return _weighted_mean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
+            return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
 
-        return with_pred(logloss)
+        return wm(term)
     if base == "error":
         threshold = float(suffix) if suffix else 0.5
-
-        def error(p, y, w):
-            return _weighted_mean(((p > threshold).astype(jnp.float32) != y).astype(jnp.float32), w)
-
-        return with_pred(error)
+        return wm(
+            lambda p, y, w: ((p > threshold).astype(jnp.float32) != y).astype(
+                jnp.float32
+            )
+        )
     if base == "auc":
-        def auc(p, y, w):
+        def partial(m, y, w):
             # weighted Mann-Whitney with tie midranks in cumulative-weight
             # space (same formulation as eval_metrics.auc, static shapes:
             # tie groups via neighbor-inequality cumsum + segment reductions)
+            p = _prob_transform(objective_name, m)
             n = p.shape[0]
             order = jnp.argsort(p)
             sp, sw = p[order], w[order]
@@ -122,40 +153,44 @@ def make_device_metric(name, objective_name, num_group=1, params=None):
             w_pos = jnp.sum(spos)
             w_neg = jnp.sum(sneg)
             u = jnp.sum(ranks * spos) - w_pos * w_pos / 2.0
-            return jnp.clip(u / jnp.maximum(w_pos * w_neg, _EPS), 0.0, 1.0)
+            pairw = w_pos * w_neg
+            auc = jnp.clip(u / jnp.maximum(pairw, _EPS), 0.0, 1.0)
+            # shards combine as a pair-weighted average (xgboost's
+            # distributed-AUC semantics); exact when single-shard
+            return jnp.stack([auc * pairw, pairw])
 
-        return with_pred(auc)
+        return DeviceMetric(name, 2, partial, lambda s: s[0] / jnp.maximum(s[1], _EPS))
     if base == "poisson-nloglik":
-        def poisson(p, y, w):
+        def term(p, y, w):
             from jax.scipy.special import gammaln
 
             p = jnp.maximum(p, _EPS)
-            return _weighted_mean(p - y * jnp.log(p) + gammaln(y + 1.0), w)
+            return p - y * jnp.log(p) + gammaln(y + 1.0)
 
-        return with_pred(poisson)
+        return wm(term)
     if base == "gamma-nloglik":
-        def gamma_nll(p, y, w):
+        def term(p, y, w):
             p = jnp.maximum(p, _EPS)
-            return _weighted_mean(jnp.log(p) + y / p, w)
+            return jnp.log(p) + y / p
 
-        return with_pred(gamma_nll)
+        return wm(term)
     if base == "gamma-deviance":
-        def gamma_dev(p, y, w):
+        def term(p, y, w):
             p = jnp.maximum(p, _EPS)
-            y = jnp.maximum(y, _EPS)
-            return 2.0 * _weighted_mean(jnp.log(p / y) + y / p - 1.0, w)
+            yy = jnp.maximum(y, _EPS)
+            return jnp.log(p / yy) + yy / p - 1.0
 
-        return with_pred(gamma_dev)
+        return wm(term, post=lambda x: 2.0 * x)
     if base == "tweedie-nloglik":
         rho = float(suffix) if suffix else float(params.get("tweedie_variance_power", 1.5))
 
-        def tweedie(p, y, w):
+        def term(p, y, w):
             p = jnp.maximum(p, _EPS)
             a = y * jnp.power(p, 1 - rho) / (1 - rho)
             b = jnp.power(p, 2 - rho) / (2 - rho)
-            return _weighted_mean(-a + b, w)
+            return -a + b
 
-        return with_pred(tweedie)
+        return wm(term)
     return None
 
 

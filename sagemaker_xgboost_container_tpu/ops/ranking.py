@@ -80,6 +80,55 @@ def build_group_layout(groups, max_group_size=None):
     return row_index
 
 
+def build_sharded_group_layout(groups, n_shards, max_group_size=None,
+                               rows_per_shard=None, max_groups_per_shard=None):
+    """Partition query groups across data shards for distributed LambdaMART.
+
+    Groups never straddle shards (pairwise gradients are intra-group, so
+    shard-local gradients stay exact — the reference's Rabit path likewise
+    keeps each worker's groups whole). Greedy longest-processing-time
+    assignment balances row counts; every shard pads to the same
+    ``rows_per_shard`` with -1 (weight-0) rows.
+
+    Returns (perm, row_index, rows_per_shard):
+      perm: int64 [n_shards * rows_per_shard] — device-order position ->
+        original row id, -1 for padding.
+      row_index: int32 [n_shards, G_max, M] — per-shard group layout in
+        SHARD-LOCAL row coordinates, -1 padding (feed one shard's [G_max, M]
+        slice to lambdarank_grad_hess inside shard_map).
+    The ``rows_per_shard`` / ``max_groups_per_shard`` / ``max_group_size``
+    overrides let multi-host runs agree on global maxima.
+    """
+    sizes = np.asarray(groups, np.int64)
+    G = len(sizes)
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    order = np.argsort(-sizes, kind="stable")
+    assign = [[] for _ in range(n_shards)]
+    loads = np.zeros(n_shards, np.int64)
+    for g in order:
+        s = int(np.argmin(loads))
+        assign[s].append(int(g))
+        loads[s] += sizes[g]
+    rps = int(rows_per_shard if rows_per_shard is not None else loads.max())
+    if loads.max() > rps:
+        raise ValueError("rows_per_shard too small for group assignment")
+    G_max = max((len(a) for a in assign), default=1) or 1
+    if max_groups_per_shard is not None:
+        G_max = max(G_max, int(max_groups_per_shard))
+    M = int(max_group_size if max_group_size is not None else sizes.max())
+    perm = np.full(n_shards * rps, -1, np.int64)
+    row_index = np.full((n_shards, G_max, M), -1, np.int32)
+    for s, group_list in enumerate(assign):
+        pos = 0
+        for gi, g in enumerate(sorted(group_list)):
+            size = min(int(sizes[g]), M)
+            rows = np.arange(starts[g], starts[g] + size, dtype=np.int64)
+            perm[s * rps + pos : s * rps + pos + size] = rows
+            row_index[s, gi, :size] = np.arange(pos, pos + size, dtype=np.int32)
+            pos += size
+    return perm, row_index, rps
+
+
 def lambdarank_grad_hess(
     margins, labels, weights, row_index, scheme="pairwise", group_chunk=256
 ):

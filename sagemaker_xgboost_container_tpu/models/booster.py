@@ -861,8 +861,11 @@ class _TrainingSession:
         xgboost, where python-side custom metrics are computed per worker
         and averaged rather than allreduced elementwise.
         """
-        results = []
-        set_weight_sums = []
+        from .device_metrics import make_device_metric
+
+        results = []       # (name, metric, local_value)
+        pairs = []         # per entry: [a, b] f64 summed across hosts
+        finalizers = []    # per entry: fn(summed [a, b]) -> global value
         for i, (name, dm, binned) in enumerate(self.eval_sets):
             margin = self.margins_for(i)
             preds = self.objective.margin_to_prediction(margin)
@@ -873,6 +876,11 @@ class _TrainingSession:
                 )
             w = dm.get_weight()
             wsum = float(np.sum(w)) if w is not None else float(dm.num_row)
+            w_arr = (
+                np.asarray(w, np.float32)
+                if w is not None
+                else np.ones(dm.num_row, np.float32)
+            )
             for metric in metric_names:
                 value = eval_metrics.evaluate(
                     metric,
@@ -883,28 +891,51 @@ class _TrainingSession:
                     prob_matrix=prob_matrix,
                 )
                 results.append((name, metric, value))
-                set_weight_sums.append(wsum)
+                if self.is_multiprocess:
+                    # decomposable metrics combine exactly from per-host
+                    # partial stats; the rest (ndcg/map) fall back to a
+                    # weight-sum-weighted mean of per-host values
+                    dmf = make_device_metric(
+                        metric,
+                        self.objective.name,
+                        self.num_group,
+                        self.config.objective_params,
+                    )
+                    if dmf is not None:
+                        stats = np.asarray(
+                            dmf.partial(
+                                jnp.asarray(margin),
+                                jnp.asarray(dm.labels),
+                                jnp.asarray(w_arr),
+                            ),
+                            np.float64,
+                        )
+                        pairs.append(stats)
+                        finalizers.append(
+                            lambda s, f=dmf: float(f.finalize(jnp.asarray(s)))
+                        )
+                    else:
+                        pairs.append(np.asarray([value * wsum, wsum], np.float64))
+                        finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
             if feval is not None:
                 # xgboost >= 1.2 convention: feval receives the raw margin
                 for metric_name, value in feval(margin, dm):
                     results.append((name, metric_name, value))
-                    set_weight_sums.append(wsum)
+                    if self.is_multiprocess:
+                        pairs.append(np.asarray([value * wsum, wsum], np.float64))
+                        finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
         if not self.is_multiprocess or not results:
             return results
         from jax.experimental import multihost_utils
 
-        vals = np.asarray([v for (_, _, v) in results], np.float64)
-        ws = np.asarray(set_weight_sums, np.float64)
         gathered = np.asarray(
             multihost_utils.process_allgather(
-                np.stack([vals * ws, ws], axis=1).astype(np.float32)
+                np.stack(pairs, axis=0).astype(np.float64)
             )
         )  # [P, n_entries, 2]
-        combined = gathered[:, :, 0].sum(axis=0) / np.maximum(
-            gathered[:, :, 1].sum(axis=0), 1e-12
-        )
+        summed = gathered.sum(axis=0)
         return [
-            (name, metric, float(combined[j]))
+            (name, metric, finalizers[j](summed[j]))
             for j, (name, metric, _v) in enumerate(results)
         ]
 

@@ -50,6 +50,16 @@ def _matmul_precision():
     return os.environ.get("GRAFT_HIST_MM_PREC", "bf16x2")
 
 
+def subtraction_enabled(cache_bytes):
+    """Shared gate for sibling-subtraction paths (both growers): the
+    GRAFT_HIST_SUBTRACT kill-switch plus a memory cap on the histogram cache
+    the caller would have to keep alive (GRAFT_SUBTRACT_MEM, default 512MB)."""
+    if os.environ.get("GRAFT_HIST_SUBTRACT", "1") != "1":
+        return False
+    cap = int(os.environ.get("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024))
+    return cache_bytes <= cap
+
+
 def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name=None):
     """Build (G, H) histograms for one tree level.
 

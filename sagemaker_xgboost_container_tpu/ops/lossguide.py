@@ -20,10 +20,18 @@ inherent to static-shape leaf-wise growth without dynamic row partitions.
 import jax
 import jax.numpy as jnp
 
-from .histogram import level_histogram
+from .histogram import level_histogram, subtraction_enabled
 from .split import find_best_splits, leaf_weight
 
 MIN_SPLIT_LOSS = 1e-6
+
+
+def _subtraction_enabled(max_leaves, d, num_bins):
+    """Sibling subtraction for leaf-wise growth: every split step histograms
+    only the LEFT fresh child (W=1 scan over rows) and derives the right one
+    from the parent's cached histogram — halving per-step histogram work.
+    Needs a [2*max_leaves-1, d, B] f32 cache x2, so gated by the shared cap."""
+    return subtraction_enabled(2 * (2 * max_leaves - 1) * d * num_bins * 4)
 
 
 def build_tree_lossguide(
@@ -92,14 +100,20 @@ def build_tree_lossguide(
 
     node_of_row = jnp.zeros(n, jnp.int32)
 
-    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab, mask=None):
+    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab, mask=None, GH=None):
         """Histogram the two fresh children and return their candidates.
 
         parent_rows_mask_nodes: node_local [n] mapping rows to {0,1,-1}.
+        GH: optional precomputed ([2, d, B], [2, d, B]) histograms (the
+        sibling-subtraction path).
         """
-        G, H = level_histogram(
-            bins, grad, hess, parent_rows_mask_nodes, 2, num_bins, axis_name=axis_name
-        )
+        if GH is not None:
+            G, H = GH
+        else:
+            G, H = level_histogram(
+                bins, grad, hess, parent_rows_mask_nodes, 2, num_bins,
+                axis_name=axis_name,
+            )
         splits = find_best_splits(
             G,
             H,
@@ -116,9 +130,18 @@ def build_tree_lossguide(
         gains = jnp.where(can_deepen, splits["gain"], -jnp.inf)
         return splits, gains
 
+    subtract = _subtraction_enabled(max_leaves, d, num_bins)
+    if subtract:
+        # per-node histogram cache (filled as leaves are created)
+        hist_G = jnp.zeros((max_nodes, d, num_bins), jnp.float32)
+        hist_H = jnp.zeros((max_nodes, d, num_bins), jnp.float32)
+
     # root candidate
     root_local = jnp.zeros(n, jnp.int32)
     G, H = level_histogram(bins, grad, hess, root_local, 1, num_bins, axis_name=axis_name)
+    if subtract:
+        hist_G = hist_G.at[0].set(G[0])
+        hist_H = hist_H.at[0].set(H[0])
     root_splits = find_best_splits(
         G, H, num_cuts,
         reg_lambda=reg_lambda, alpha=alpha, gamma=gamma,
@@ -179,8 +202,22 @@ def build_tree_lossguide(
             draw = jax.random.uniform(jax.random.fold_in(rng, 7919 + t), (2, d))
             sampled = (draw < colsample_bynode).astype(jnp.float32)
             node_mask = sampled if node_mask is None else sampled * node_mask[None, :]
+        GH = None
+        if subtract:
+            # histogram only the LEFT child; right = cached parent - left.
+            # When the step can't split, no rows were routed: left is all
+            # zeros and the right side is forced to zero too.
+            left_local = jnp.where(can & (node_of_row == id_a), 0, -1)
+            Ga, Ha = level_histogram(
+                bins, grad, hess, left_local, 1, num_bins, axis_name=axis_name
+            )
+            Gb = jnp.where(can, hist_G[l] - Ga[0], 0.0)
+            Hb = jnp.where(can, hist_H[l] - Ha[0], 0.0)
+            GH = (jnp.stack([Ga[0], Gb]), jnp.stack([Ha[0], Hb]))
+            hist_G = hist_G.at[id_a].set(Ga[0]).at[id_b].set(Gb)
+            hist_H = hist_H.at[id_a].set(Ha[0]).at[id_b].set(Hb)
         splits, child_gains = _score_children(
-            child_local, id_a, id_b, jnp.stack([depth_ab, depth_ab]), node_mask
+            child_local, id_a, id_b, jnp.stack([depth_ab, depth_ab]), node_mask, GH=GH
         )
         valid = can
         cand["gain"] = cand["gain"].at[id_a].set(jnp.where(valid, child_gains[0], -jnp.inf))

@@ -13,12 +13,10 @@ finalized rows hold -1 and accumulate their leaf value into ``row_out``, so
 the booster updates margins without re-predicting the train set.
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 
-from .histogram import level_histogram, node_totals
+from .histogram import level_histogram, node_totals, subtraction_enabled
 from .split import find_best_splits, leaf_weight
 
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
@@ -32,14 +30,10 @@ def _subtraction_enabled(max_depth, d, num_bins):
     """Histogram subtraction: build only left children, derive right ones as
     parent - left (libxgboost's standard sibling trick) — halves histogram
     work per level. Needs the previous level's histograms cached
-    ([2**(L-1), d, B] f32 x2); gated by a memory cap for very deep trees."""
-    if os.environ.get("GRAFT_HIST_SUBTRACT", "1") != "1":
-        return False
+    ([2**(L-1), d, B] f32 x2); gated by the shared memory cap."""
     if max_depth < 2:
         return False
-    cache_bytes = 2 * (2 ** (max_depth - 1)) * d * num_bins * 4
-    cap = int(os.environ.get("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024))
-    return cache_bytes <= cap
+    return subtraction_enabled(2 * (2 ** (max_depth - 1)) * d * num_bins * 4)
 
 
 def build_tree(
@@ -356,15 +350,23 @@ def unpack_tree(packed):
 def predict_binned(tree, bins, max_depth, num_bins):
     """Apply one trained tree to binned rows -> margins.
 
-    Traverses explicit child indices (leaves self-loop) for ``max_depth``
-    steps — the max root->leaf distance for depthwise trees, max_leaves-1 for
-    lossguide. Used for validation-set evaluation during training (validation
-    is binned with the training cuts, so bin comparison == float comparison).
+    Traverses explicit child indices (leaves self-loop) under a
+    ``lax.while_loop`` that stops as soon as every row sits on a leaf;
+    ``max_depth`` is only the static upper bound (max root->leaf distance for
+    depthwise trees, max_leaves-1 for lossguide), so a 256-leaf lossguide
+    tree of actual depth ~8 costs ~8 gather rounds, not 255. Used for
+    validation-set evaluation during training (validation is binned with the
+    training cuts, so bin comparison == float comparison).
     """
     n = bins.shape[0]
     bins = bins.astype(jnp.int32)
-    node = jnp.zeros(n, jnp.int32)
-    for _ in range(max_depth):
+
+    def cond(state):
+        i, node = state
+        return (i < max_depth) & jnp.any(~tree["is_leaf"][node])
+
+    def body(state):
+        i, node = state
         feat = tree["feature"][node]
         split_bin = tree["bin"][node]
         row_bin = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
@@ -372,4 +374,9 @@ def predict_binned(tree, bins, max_depth, num_bins):
         go_right = jnp.where(is_missing, ~tree["default_left"][node], row_bin > split_bin)
         child = jnp.where(go_right, tree["right"][node], tree["left"][node])
         node = jnp.where(tree["is_leaf"][node], node, child)
+        return i + 1, node
+
+    _, node = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.zeros(n, jnp.int32))
+    )
     return tree["leaf_value"][node]

@@ -536,6 +536,10 @@ def test_two_process_global_metrics_exact():
     # the final model over the FULL (combined) datasets — global exactness,
     # not per-host values (VERDICT r1 missing #1)
     check = got[0][2]
+    np.testing.assert_allclose(
+        got[0][1]["train"]["logloss"][-1], check["host3_logloss"],
+        rtol=2e-4, atol=2e-5, err_msg="mixed-watchlist logloss exactness",
+    )
     for key in ("train", "validation"):
         np.testing.assert_allclose(
             got[0][0][key]["logloss"][-1], check[key + "_logloss"],

@@ -124,9 +124,16 @@ def distributed_metrics_worker(rank, world, port, q):
     host_log = {}
     params_host = dict(params)
     params_host.pop("_rounds_per_dispatch")
-    train(
+    forest3 = train(
         params_host, dtrain, num_boost_round=3,
         evals=[(dtrain, "train")], feval=feval,
         callbacks=[recorder(host_log)], mesh=mesh,
+    )
+    # mixed watchlist (decomposable + feval): the decomposable ones must
+    # STILL be globally exact (combined from partial stats, not from a
+    # weighted mean of per-host values)
+    p3 = np.clip(np.asarray(forest3.predict(X)), 1e-7, 1 - 1e-7)
+    check["host3_logloss"] = float(
+        -np.mean(y * np.log(p3) + (1 - y) * np.log(1 - p3))
     )
     q.put((rank, dev_log, host_log, check))

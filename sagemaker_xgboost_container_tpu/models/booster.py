@@ -863,25 +863,59 @@ class _TrainingSession:
         """
         from .device_metrics import make_device_metric
 
-        results = []       # (name, metric, local_value)
-        pairs = []         # per entry: [a, b] f64 summed across hosts
+        results = []       # (name, metric, local_value or None placeholder)
+        pairs = []         # per entry: [a, b] stats (f32 on device) to sum
         finalizers = []    # per entry: fn(summed [a, b]) -> global value
+
+        def append_weighted_mean(value, wsum):
+            pairs.append(np.asarray([value * wsum, wsum], np.float64))
+            finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
+
         for i, (name, dm, binned) in enumerate(self.eval_sets):
             margin = self.margins_for(i)
-            preds = self.objective.margin_to_prediction(margin)
+            preds = None
             prob_matrix = None
-            if self.num_group > 1:
-                prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
-                    self.objective, margin
-                )
             w = dm.get_weight()
             wsum = float(np.sum(w)) if w is not None else float(dm.num_row)
-            w_arr = (
-                np.asarray(w, np.float32)
-                if w is not None
-                else np.ones(dm.num_row, np.float32)
-            )
             for metric in metric_names:
+                dmf = (
+                    make_device_metric(
+                        metric,
+                        self.objective.name,
+                        self.num_group,
+                        self.config.objective_params,
+                    )
+                    if self.is_multiprocess
+                    else None
+                )
+                if dmf is not None:
+                    # decomposable: combine exactly from per-host partial
+                    # stats; skip the (discarded) host-local evaluation
+                    w_arr = (
+                        np.asarray(w, np.float32)
+                        if w is not None
+                        else np.ones(dm.num_row, np.float32)
+                    )
+                    stats = np.asarray(
+                        dmf.partial(
+                            jnp.asarray(margin),
+                            jnp.asarray(dm.labels),
+                            jnp.asarray(w_arr),
+                        ),
+                        np.float64,
+                    )
+                    results.append((name, metric, None))
+                    pairs.append(stats)
+                    finalizers.append(
+                        lambda s, f=dmf: float(f.finalize(jnp.asarray(s, dtype=jnp.float32)))
+                    )
+                    continue
+                if preds is None:
+                    preds = self.objective.margin_to_prediction(margin)
+                    if self.num_group > 1:
+                        prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
+                            self.objective, margin
+                        )
                 value = eval_metrics.evaluate(
                     metric,
                     preds,
@@ -892,46 +926,27 @@ class _TrainingSession:
                 )
                 results.append((name, metric, value))
                 if self.is_multiprocess:
-                    # decomposable metrics combine exactly from per-host
-                    # partial stats; the rest (ndcg/map) fall back to a
-                    # weight-sum-weighted mean of per-host values
-                    dmf = make_device_metric(
-                        metric,
-                        self.objective.name,
-                        self.num_group,
-                        self.config.objective_params,
-                    )
-                    if dmf is not None:
-                        stats = np.asarray(
-                            dmf.partial(
-                                jnp.asarray(margin),
-                                jnp.asarray(dm.labels),
-                                jnp.asarray(w_arr),
-                            ),
-                            np.float64,
-                        )
-                        pairs.append(stats)
-                        finalizers.append(
-                            lambda s, f=dmf: float(f.finalize(jnp.asarray(s)))
-                        )
-                    else:
-                        pairs.append(np.asarray([value * wsum, wsum], np.float64))
-                        finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
+                    # non-decomposable (ndcg/map): weight-sum-weighted mean
+                    append_weighted_mean(value, wsum)
             if feval is not None:
                 # xgboost >= 1.2 convention: feval receives the raw margin
                 for metric_name, value in feval(margin, dm):
                     results.append((name, metric_name, value))
                     if self.is_multiprocess:
-                        pairs.append(np.asarray([value * wsum, wsum], np.float64))
-                        finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
+                        append_weighted_mean(value, wsum)
         if not self.is_multiprocess or not results:
             return results
         from jax.experimental import multihost_utils
 
+        # device partial stats are f32 (x64 is not enabled); the allgather
+        # rides the device too, so transport is f32 — the cross-host SUM
+        # happens host-side in f64 to avoid accumulating f32 rounding over
+        # many hosts
         gathered = np.asarray(
             multihost_utils.process_allgather(
-                np.stack(pairs, axis=0).astype(np.float64)
-            )
+                np.stack(pairs, axis=0).astype(np.float32)
+            ),
+            np.float64,
         )  # [P, n_entries, 2]
         summed = gathered.sum(axis=0)
         return [

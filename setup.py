@@ -1,11 +1,26 @@
 import os
 
-from setuptools import find_packages, setup
+from setuptools import Extension, find_packages, setup
 
 
 def read(fname):
     with open(os.path.join(os.path.dirname(__file__), fname)) as f:
         return f.read()
+
+
+# The native data plane (libsvm tokenizer) ships as a compiled artifact in
+# the wheel so installed images get the C++ parser, not the silent Python
+# fallback (the reference likewise builds its ingestion natively — MLIO /
+# libxgboost parsers, SURVEY.md §2.2). It is a plain C-ABI library loaded
+# via ctypes, built through the Extension machinery purely for packaging;
+# optional=True keeps pip install working on compiler-less hosts (the
+# runtime then lazily compiles from source or falls back to Python).
+fastdata_ext = Extension(
+    "sagemaker_xgboost_container_tpu._fastdata",
+    sources=["native/fastdata.cpp"],
+    extra_compile_args=["-O3"],
+    optional=True,
+)
 
 
 setup(
@@ -19,6 +34,7 @@ setup(
     long_description_content_type="text/markdown",
     packages=find_packages(exclude=("tests",)),
     package_data={"sagemaker_xgboost_container_tpu.data": ["record_pb2.py"]},
+    ext_modules=[fastdata_ext],
     python_requires=">=3.10",
     install_requires=[
         "jax",

@@ -77,19 +77,12 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round)
 
     if forest.trees:
         # checkpoint resume: dropout must cover the checkpoint's trees too, so
-        # rebuild their per-row contributions (one stacked-kernel pass)
-        from ..ops.predict import _forest_margin
+        # rebuild their per-row contributions (one stacked-kernel pass;
+        # categorical-aware for BYO xgboost checkpoints)
+        from ..ops.predict import forest_leaf_margins
 
         stacked = forest._stack(slice(0, len(forest.trees)))
-        depth = stacked.pop("depth")
-        leaf = _forest_margin(
-            *(jnp.asarray(stacked[k]) for k in (
-                "feature", "threshold", "default_left", "left", "right",
-                "is_leaf", "leaf_value",
-            )),
-            jnp.asarray(dtrain.features),
-            depth,
-        )  # [n, T]
+        leaf = forest_leaf_margins(stacked, dtrain.features)  # [n, T]
         for i in range(len(forest.trees)):
             tree_contribs.append(leaf[:, i])
             tree_weights.append(1.0)

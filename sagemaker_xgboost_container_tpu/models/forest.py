@@ -23,10 +23,21 @@ from . import objectives as objectives_mod
 
 
 class Tree:
-    """One decision tree, compact arrays, xgboost node ordering (root = 0)."""
+    """One decision tree, compact arrays, xgboost node ordering (root = 0).
+
+    ``categories``: optional dict {node_id: int array} for partition-based
+    categorical splits (xgboost ``enable_categorical``). Stored categories
+    are the set that routes to the RIGHT child (xgboost
+    common::Decision semantics: category in set -> not default-left branch
+    decision -> right); invalid/missing categories follow ``default_left``.
+    Our trainer never produces these — they exist for BYO xgboost models
+    loaded for serving (reference serve_utils.py:171-197 loads any customer
+    model through libxgboost, which handles categorical nodes natively).
+    """
 
     def __init__(self, feature, threshold, default_left, left, right, value,
-                 base_weight=None, gain=None, sum_hess=None, parent=None):
+                 base_weight=None, gain=None, sum_hess=None, parent=None,
+                 categories=None):
         self.feature = np.asarray(feature, np.int32)
         self.threshold = np.asarray(threshold, np.float32)
         self.default_left = np.asarray(default_left, np.bool_)
@@ -43,10 +54,22 @@ class Tree:
             parent if parent is not None else _parents_from_children(self.left, self.right),
             np.int32,
         )
+        self.categories = {
+            int(k): np.asarray(v, np.int64) for k, v in (categories or {}).items()
+        }
 
     @property
     def num_nodes(self):
         return len(self.feature)
+
+    @property
+    def has_categorical(self):
+        return bool(self.categories)
+
+    def max_category(self):
+        return max(
+            (int(v.max()) for v in self.categories.values() if len(v)), default=-1
+        )
 
     @property
     def is_leaf(self):
@@ -202,7 +225,7 @@ class Forest:
         is_leaf = left < 0
         left = np.where(is_leaf, self_idx, left)
         right = np.where(is_leaf, self_idx, right)
-        return {
+        stacked = {
             "feature": pad(lambda t: t.feature, np.int32),
             "threshold": pad(lambda t: t.threshold, np.float32),
             "default_left": pad(lambda t: t.default_left, np.bool_),
@@ -212,6 +235,20 @@ class Forest:
             "leaf_value": pad(lambda t: t.value, np.float32),
             "depth": max(t.depth() for t in trees),
         }
+        max_cat = max((t.max_category() for t in trees), default=-1)
+        if max_cat >= 0:
+            # bitmask of right-branch categories per node: [T, N, W] u32
+            W = (max_cat >> 5) + 1
+            cat_split = np.zeros((T, N), np.bool_)
+            cat_mask = np.zeros((T, N, W), np.uint32)
+            for i, t in enumerate(trees):
+                for node, cats in t.categories.items():
+                    cat_split[i, node] = True
+                    for c in cats:
+                        cat_mask[i, node, c >> 5] |= np.uint32(1) << np.uint32(c & 31)
+            stacked["cat_split"] = cat_split
+            stacked["cat_mask"] = cat_mask
+        return stacked
 
     def predict_margin(self, features, iteration_range=None):
         """features: np [n, d] float32 with NaN missing -> margins."""
@@ -261,7 +298,7 @@ class Forest:
 
     def predict_leaf(self, features, iteration_range=None):
         """Leaf index per (row, tree) — xgboost ``predict(pred_leaf=True)``."""
-        from ..ops.predict import _forest_leaf_nodes
+        from ..ops.predict import forest_leaf_nodes
 
         if iteration_range is None:
             lo, hi = 0, self.num_boosted_rounds
@@ -274,19 +311,7 @@ class Forest:
         features = np.asarray(features, np.float32)
         if stacked is None:
             return np.zeros((features.shape[0], 0), np.int32)
-        import jax.numpy as jnp
-
-        nodes = _forest_leaf_nodes(
-            jnp.asarray(stacked["feature"]),
-            jnp.asarray(stacked["threshold"]),
-            jnp.asarray(stacked["default_left"]),
-            jnp.asarray(stacked["left"]),
-            jnp.asarray(stacked["right"]),
-            jnp.asarray(stacked["is_leaf"]),
-            jnp.asarray(features),
-            stacked["depth"],
-        )
-        return np.asarray(nodes)
+        return np.asarray(forest_leaf_nodes(stacked, features))
 
     # ------------------------------------------------------------ attributes
     def attr(self, key):
@@ -366,15 +391,26 @@ class Forest:
                 else:
                     left, right = int(tree.left[node]), int(tree.right[node])
                     missing = left if tree.default_left[node] else right
-                    line = "{}{}:[{}<{:.9g}] yes={},no={},missing={}".format(
-                        indent,
-                        node,
-                        name(int(tree.feature[node])),
-                        float(tree.threshold[node]),
-                        left,
-                        right,
-                        missing,
-                    )
+                    if node in tree.categories:
+                        # xgboost categorical dump: the right-branch set,
+                        # with yes/no swapped (in-set routes right)
+                        cond = "{}:{{{}}}".format(
+                            name(int(tree.feature[node])),
+                            ",".join(str(int(c)) for c in tree.categories[node]),
+                        )
+                        line = "{}{}:[{}] yes={},no={},missing={}".format(
+                            indent, node, cond, right, left, missing
+                        )
+                    else:
+                        line = "{}{}:[{}<{:.9g}] yes={},no={},missing={}".format(
+                            indent,
+                            node,
+                            name(int(tree.feature[node])),
+                            float(tree.threshold[node]),
+                            left,
+                            right,
+                            missing,
+                        )
                     if with_stats:
                         line += ",gain={:.9g},cover={:.9g}".format(
                             float(tree.gain[node]), float(tree.sum_hess[node])
@@ -410,12 +446,21 @@ class Forest:
         # xgboost: split_conditions holds the threshold for splits, the leaf
         # value for leaves; split_indices is 0 at leaves.
         split_conditions = np.where(is_leaf, tree.value, tree.threshold)
+        cats, cat_nodes, cat_segs, cat_sizes = [], [], [], []
+        split_type = [0] * tree.num_nodes
+        for node in sorted(tree.categories):
+            node_cats = tree.categories[node]
+            cat_nodes.append(int(node))
+            cat_segs.append(len(cats))
+            cat_sizes.append(len(node_cats))
+            cats.extend(int(c) for c in node_cats)
+            split_type[node] = 1
         return {
             "base_weights": [float(v) for v in tree.base_weight],
-            "categories": [],
-            "categories_nodes": [],
-            "categories_segments": [],
-            "categories_sizes": [],
+            "categories": cats,
+            "categories_nodes": cat_nodes,
+            "categories_segments": cat_segs,
+            "categories_sizes": cat_sizes,
             "default_left": [int(b) for b in tree.default_left],
             "id": tree_id,
             "left_children": [int(v) for v in tree.left],
@@ -424,7 +469,7 @@ class Forest:
             "parents": [int(v) for v in tree.parent],
             "split_conditions": [float(v) for v in split_conditions],
             "split_indices": [int(v) for v in tree.feature],
-            "split_type": [0] * tree.num_nodes,
+            "split_type": split_type,
             "sum_hessian": [float(v) for v in tree.sum_hess],
             "tree_param": {
                 "num_deleted": "0",
@@ -436,12 +481,18 @@ class Forest:
 
     @staticmethod
     def _tree_from_json(blob):
+        categories = None
         if blob.get("categories_nodes"):
-            raise exc.UserError(
-                "This model uses categorical splits (xgboost enable_categorical), "
-                "which the TPU predictor does not support yet; re-train with "
-                "one-hot/ordinal encoded features."
-            )
+            # xgboost stores all categorical nodes' right-branch category
+            # sets in one flat list with per-node segments
+            flat = np.asarray(blob.get("categories", []), np.int64)
+            nodes = blob["categories_nodes"]
+            segs = blob.get("categories_segments", [])
+            sizes = blob.get("categories_sizes", [])
+            categories = {
+                int(node): flat[int(segs[j]) : int(segs[j]) + int(sizes[j])]
+                for j, node in enumerate(nodes)
+            }
         left = np.asarray(blob["left_children"], np.int32)
         is_leaf = left < 0
         cond = np.asarray(blob["split_conditions"], np.float32)
@@ -456,6 +507,7 @@ class Forest:
             gain=blob.get("loss_changes"),
             sum_hess=blob.get("sum_hessian"),
             parent=blob.get("parents"),
+            categories=categories,
         )
 
     def save_json(self):

@@ -38,6 +38,79 @@ def _forest_leaf_nodes(feature, threshold, default_left, left, right, is_leaf, x
 
 
 @partial(jax.jit, static_argnames=("depth",))
+def _forest_leaf_nodes_cat(
+    feature, threshold, default_left, left, right, is_leaf,
+    cat_split, cat_mask, x, depth,
+):
+    """Traversal with partition-based categorical nodes (BYO xgboost models).
+
+    cat_split: bool [T, N] — node is categorical; cat_mask: u32 [T, N, W]
+    bitmask of the categories routed RIGHT (xgboost common::Decision:
+    in-set -> right; invalid/missing -> default direction). The numerical
+    path is identical to _forest_leaf_nodes.
+    """
+    n = x.shape[0]
+    T = feature.shape[0]
+    W = cat_mask.shape[2]
+    max_cat = W * 32
+    node = jnp.zeros((n, T), jnp.int32)
+    t_idx = jnp.broadcast_to(jnp.arange(T)[None, :], (n, T))
+
+    for _ in range(depth):
+        feat = feature[t_idx, node]            # [n, T]
+        thr = threshold[t_idx, node]
+        v = jnp.take_along_axis(x, feat.reshape(n, -1), axis=1).reshape(n, T)
+        miss = jnp.isnan(v)
+        dfl = default_left[t_idx, node]
+
+        cat = jnp.nan_to_num(v, nan=-1.0).astype(jnp.int32)
+        # xgboost common::Decision: MISSING follows the default direction,
+        # but an invalid (negative / out-of-range) category goes LEFT
+        # unconditionally
+        invalid = (cat < 0) | (cat >= max_cat)
+        safe_cat = jnp.clip(cat, 0, max_cat - 1)
+        word = cat_mask[t_idx, node, safe_cat >> 5]
+        in_set = ((word >> (safe_cat & 31).astype(jnp.uint32)) & 1) == 1
+        go_right_cat = jnp.where(
+            miss, ~dfl, jnp.where(invalid, False, in_set)
+        )
+
+        go_right_num = jnp.where(miss, ~dfl, v >= thr)
+        go_right = jnp.where(cat_split[t_idx, node], go_right_cat, go_right_num)
+        nxt = jnp.where(go_right, right[t_idx, node], left[t_idx, node])
+        node = jnp.where(is_leaf[t_idx, node], node, nxt)
+    return node
+
+
+def forest_leaf_nodes(stacked, x):
+    """Dispatch: the plain numerical kernel, or the categorical-aware one
+    when the stacked forest carries category bitmasks."""
+    if "cat_split" in stacked:
+        return _forest_leaf_nodes_cat(
+            jnp.asarray(stacked["feature"]),
+            jnp.asarray(stacked["threshold"]),
+            jnp.asarray(stacked["default_left"]),
+            jnp.asarray(stacked["left"]),
+            jnp.asarray(stacked["right"]),
+            jnp.asarray(stacked["is_leaf"]),
+            jnp.asarray(stacked["cat_split"]),
+            jnp.asarray(stacked["cat_mask"]),
+            jnp.asarray(x, jnp.float32),
+            stacked["depth"],
+        )
+    return _forest_leaf_nodes(
+        jnp.asarray(stacked["feature"]),
+        jnp.asarray(stacked["threshold"]),
+        jnp.asarray(stacked["default_left"]),
+        jnp.asarray(stacked["left"]),
+        jnp.asarray(stacked["right"]),
+        jnp.asarray(stacked["is_leaf"]),
+        jnp.asarray(x, jnp.float32),
+        stacked["depth"],
+    )
+
+
+@partial(jax.jit, static_argnames=("depth",))
 def _forest_margin(feature, threshold, default_left, left, right, is_leaf, leaf_value, x, depth):
     """x: f32 [n, d] (NaN = missing) -> per-tree-group margins [n].
 
@@ -51,23 +124,57 @@ def _forest_margin(feature, threshold, default_left, left, right, is_leaf, leaf_
     return leaf_value[t_idx, node]             # [n, T]
 
 
+@partial(jax.jit, static_argnames=("depth",))
+def _forest_margin_cat(
+    feature, threshold, default_left, left, right, is_leaf,
+    cat_split, cat_mask, leaf_value, x, depth,
+):
+    T = feature.shape[0]
+    t_idx = jnp.arange(T)[None, :]
+    node = _forest_leaf_nodes_cat(
+        feature, threshold, default_left, left, right, is_leaf,
+        cat_split, cat_mask, x, depth,
+    )
+    return leaf_value[t_idx, node]             # [n, T]
+
+
+def forest_leaf_margins(stacked, x):
+    """Per-tree leaf contributions [n, T]; one cached XLA program either way
+    (categorical-aware when the stacked forest carries category bitmasks)."""
+    if "cat_split" in stacked:
+        return _forest_margin_cat(
+            jnp.asarray(stacked["feature"]),
+            jnp.asarray(stacked["threshold"]),
+            jnp.asarray(stacked["default_left"]),
+            jnp.asarray(stacked["left"]),
+            jnp.asarray(stacked["right"]),
+            jnp.asarray(stacked["is_leaf"]),
+            jnp.asarray(stacked["cat_split"]),
+            jnp.asarray(stacked["cat_mask"]),
+            jnp.asarray(stacked["leaf_value"]),
+            jnp.asarray(x, jnp.float32),
+            stacked["depth"],
+        )
+    return _forest_margin(
+        jnp.asarray(stacked["feature"]),
+        jnp.asarray(stacked["threshold"]),
+        jnp.asarray(stacked["default_left"]),
+        jnp.asarray(stacked["left"]),
+        jnp.asarray(stacked["right"]),
+        jnp.asarray(stacked["is_leaf"]),
+        jnp.asarray(stacked["leaf_value"]),
+        jnp.asarray(x, jnp.float32),
+        stacked["depth"],
+    )
+
+
 def forest_predict_margin(stacked, x, num_output_group=1, base_margin=0.0, tree_info=None):
     """Sum per-tree leaf outputs into per-group margins.
 
     stacked: dict of [T, N] numpy/jnp arrays + "depth" int.
     Returns [n] (single group) or [n, num_output_group].
     """
-    leaf = _forest_margin(
-        stacked["feature"],
-        stacked["threshold"],
-        stacked["default_left"],
-        stacked["left"],
-        stacked["right"],
-        stacked["is_leaf"],
-        stacked["leaf_value"],
-        jnp.asarray(x, jnp.float32),
-        stacked["depth"],
-    )
+    leaf = forest_leaf_margins(stacked, x)
     if num_output_group == 1:
         return np.asarray(leaf.sum(axis=1)) + base_margin
     # group trees by class id (tree_info) — static host-side partition

@@ -1,0 +1,163 @@
+"""Categorical-split (partition-based) BYO model support.
+
+The reference serves any customer xgboost model because libxgboost evaluates
+categorical nodes natively (reference serve_utils.py:171-197). Here the
+xgboost JSON categorical schema (categories / categories_nodes /
+categories_segments / categories_sizes, split_type=1) loads into the Tree
+category sets and evaluates via the bitmask predict kernel: a category IN
+the stored set routes RIGHT (xgboost common::Decision), invalid or missing
+values follow default_left.
+
+The model fixture is hand-authored to the public xgboost JSON schema (no
+xgboost import available in this image), with values chosen so every branch
+is hand-checkable.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.models.forest import Forest, Tree
+
+
+def _categorical_forest():
+    """Root: categorical split on f0 with right-set {2, 5}; left child is a
+    numerical split on f1 at 0.5; right child is leaf +2.0."""
+    tree = Tree(
+        feature=[0, 1, 0, 0, 0],
+        threshold=[0.0, 0.5, 0.0, 0.0, 0.0],
+        default_left=[True, False, False, False, False],
+        left=[1, 3, -1, -1, -1],
+        right=[2, 4, -1, -1, -1],
+        value=[0.0, 0.0, 2.0, -1.0, 1.0],
+        categories={0: [2, 5]},
+    )
+    forest = Forest(
+        objective_name="reg:squarederror",
+        objective_params={},
+        base_score=0.0,
+        num_feature=2,
+    )
+    forest.trees = [tree]
+    forest.tree_info = [0]
+    forest.iteration_indptr = [0, 1]
+    return forest
+
+
+CASES = [
+    # (f0, f1) -> expected margin
+    ((2.0, 0.0), 2.0),    # category 2 in {2,5} -> right leaf
+    ((5.0, 0.0), 2.0),    # category 5 in set -> right leaf
+    ((3.0, 0.2), -1.0),   # not in set -> left subtree, f1 < 0.5 -> leaf -1
+    ((3.0, 0.9), 1.0),    # not in set -> left subtree, f1 >= 0.5 -> leaf 1
+    ((np.nan, 0.2), -1.0),  # missing -> default_left=True -> left subtree
+    ((-1.0, 0.9), 1.0),   # negative category invalid -> default left
+    ((40.0, 0.2), -1.0),  # beyond bitmask range invalid -> default left
+]
+
+
+def test_categorical_predict_hand_checked():
+    forest = _categorical_forest()
+    X = np.asarray([c[0] for c in CASES], np.float32)
+    want = np.asarray([c[1] for c in CASES], np.float32)
+    got = forest.predict(X, output_margin=True)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_categorical_json_roundtrip(tmp_path):
+    forest = _categorical_forest()
+    text = forest.save_json()
+    blob = json.loads(text)
+    tree_blob = blob["learner"]["gradient_booster"]["model"]["trees"][0]
+    assert tree_blob["categories_nodes"] == [0]
+    assert tree_blob["categories"] == [2, 5]
+    assert tree_blob["split_type"][0] == 1
+
+    loaded = Forest.load_json(text)
+    assert loaded.trees[0].has_categorical
+    np.testing.assert_array_equal(loaded.trees[0].categories[0], [2, 5])
+    X = np.asarray([c[0] for c in CASES], np.float32)
+    np.testing.assert_allclose(
+        loaded.predict(X, output_margin=True),
+        forest.predict(X, output_margin=True),
+        atol=1e-6,
+    )
+
+
+def test_invalid_category_goes_left_missing_goes_default():
+    """xgboost common::Decision: NaN follows default_left, but an invalid
+    (negative / out-of-bitfield) category routes LEFT unconditionally. A
+    default-RIGHT categorical node distinguishes the two."""
+    tree = Tree(
+        feature=[0, 0, 0],
+        threshold=[0.0, 0.0, 0.0],
+        default_left=[False, False, False],   # missing -> right
+        left=[1, -1, -1],
+        right=[2, -1, -1],
+        value=[0.0, -1.0, 2.0],
+        categories={0: [3]},
+    )
+    forest = Forest(
+        objective_name="reg:squarederror", objective_params={},
+        base_score=0.0, num_feature=1,
+    )
+    forest.trees = [tree]
+    forest.tree_info = [0]
+    forest.iteration_indptr = [0, 1]
+    X = np.asarray([[3.0], [1.0], [np.nan], [-2.0], [70.0]], np.float32)
+    got = forest.predict(X, output_margin=True)
+    #        in-set->R  not-in->L  miss->R(default)  invalid->L  invalid->L
+    np.testing.assert_allclose(got, [2.0, -1.0, 2.0, -1.0, -1.0], atol=1e-6)
+
+
+def test_categorical_dump_format():
+    forest = _categorical_forest()
+    dump = forest.get_dump()[0]
+    first = dump.splitlines()[0]
+    assert "{2,5}" in first and "yes=2" in first and "no=1" in first, first
+
+
+def test_categorical_pred_leaf():
+    forest = _categorical_forest()
+    X = np.asarray([(2.0, 0.0), (3.0, 0.2), (3.0, 0.9)], np.float32)
+    leaves = forest.predict(X, pred_leaf=True)
+    np.testing.assert_array_equal(leaves[:, 0], [2, 3, 4])
+
+
+def test_categorical_through_serving(tmp_path):
+    from sagemaker_xgboost_container_tpu.serving import serve_utils
+
+    forest = _categorical_forest()
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    (model_dir / "xgboost-model").write_text(forest.save_json())
+
+    model, fmt = serve_utils.get_loaded_booster(str(model_dir))
+    X = np.asarray([c[0] for c in CASES], np.float32)
+    want = np.asarray([c[1] for c in CASES], np.float32)
+    got = model.predict(X, output_margin=True)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_numerical_models_unaffected():
+    """A forest without categorical nodes must not stack cat arrays."""
+    tree = Tree(
+        feature=[0, 0, 0],
+        threshold=[0.5, 0.0, 0.0],
+        default_left=[True, False, False],
+        left=[1, -1, -1],
+        right=[2, -1, -1],
+        value=[0.0, -1.0, 1.0],
+    )
+    forest = Forest(
+        objective_name="reg:squarederror", objective_params={},
+        base_score=0.0, num_feature=1,
+    )
+    forest.trees = [tree]
+    forest.tree_info = [0]
+    forest.iteration_indptr = [0, 1]
+    stacked = forest._stack(slice(0, 1))
+    assert "cat_split" not in stacked
+    got = forest.predict(np.asarray([0.2, 0.9], np.float32)[:, None], output_margin=True)
+    np.testing.assert_allclose(got, [-1.0, 1.0], atol=1e-6)

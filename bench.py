@@ -28,29 +28,77 @@ import numpy as np  # noqa: E402
 BENCH_TIMEOUT_S = int(os.getenv("BENCH_TIMEOUT_S", "2400"))
 
 
-def _supervised_main():
+def _run_child(env_extra, timeout):
+    """One supervised child run -> parsed JSON dict or (None, note)."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
+    env.update(env_extra)
     try:
         result = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             env=env,
             capture_output=True,
             text=True,
-            timeout=BENCH_TIMEOUT_S,
+            timeout=timeout,
         )
         for line in reversed(result.stdout.splitlines()):
             if line.startswith("{"):
-                print(line)
-                return
+                return json.loads(line), None
         err_tail = " | ".join(result.stderr.strip().splitlines()[-3:])[-400:]
-        note = "benchmark child produced no result (rc={}): {}".format(
+        return None, "child produced no result (rc={}): {}".format(
             result.returncode, err_tail
         )
     except subprocess.TimeoutExpired:
-        note = "benchmark timed out after {}s (TPU tunnel unavailable?)".format(
-            BENCH_TIMEOUT_S
-        )
+        return None, "child timed out after {}s".format(timeout)
+
+
+def _supervised_main():
+    """A/B the histogram impls (each in its own supervised child — a
+    wedging impl or a dead TPU tunnel cannot take the bench down), then run
+    the full measurement with the winner. GRAFT_HIST_IMPL pins one impl."""
+    deadline = time.monotonic() + BENCH_TIMEOUT_S
+    probe_timeout = int(os.getenv("BENCH_PROBE_TIMEOUT_S", "600"))
+    impls = (
+        [os.environ["GRAFT_HIST_IMPL"]]
+        if os.environ.get("GRAFT_HIST_IMPL")
+        else ["flat", "matmul", "pallas"]
+    )
+    note = "no probe succeeded"
+    best_impl, best_value = None, -1.0
+    if len(impls) == 1:
+        best_impl = impls[0]
+    else:
+        for impl in impls:
+            remaining = deadline - time.monotonic()
+            if remaining < 10:
+                note = "benchmark timed out after {}s".format(BENCH_TIMEOUT_S)
+                break
+            budget = min(probe_timeout, max(10, int(remaining) - 60))
+            doc, err = _run_child(
+                {
+                    "GRAFT_HIST_IMPL": impl,
+                    "BENCH_ROUNDS_N": os.getenv("BENCH_PROBE_ROUNDS", "3"),
+                    "BENCH_WARMUP": "1",
+                },
+                budget,
+            )
+            if doc and doc.get("value", 0) > 0:
+                sys.stderr.write("probe {}: {} r/s\n".format(impl, doc["value"]))
+                if doc["value"] > best_value:
+                    best_impl, best_value = impl, doc["value"]
+            else:
+                sys.stderr.write("probe {} failed: {}\n".format(impl, err))
+                note = err or note
+    remaining = deadline - time.monotonic()
+    if best_impl is not None and remaining >= 10:
+        doc, err = _run_child({"GRAFT_HIST_IMPL": best_impl}, int(remaining))
+        if doc:
+            doc["metric"] = "{} [hist_impl={}]".format(doc["metric"], best_impl)
+            print(json.dumps(doc))
+            return
+        note = err or "benchmark timed out after {}s".format(BENCH_TIMEOUT_S)
+    elif best_impl is not None:
+        note = "benchmark timed out after {}s".format(BENCH_TIMEOUT_S)
     print(
         json.dumps(
             {
@@ -65,7 +113,7 @@ def _supervised_main():
 N_ROWS = int(os.getenv("BENCH_ROWS", "1000000"))
 N_FEATURES = int(os.getenv("BENCH_FEATURES", "28"))
 MAX_DEPTH = int(os.getenv("BENCH_MAX_DEPTH", "8"))
-WARMUP_ROUNDS = 3
+WARMUP_ROUNDS = int(os.getenv("BENCH_WARMUP", "3"))
 BENCH_ROUNDS = int(os.getenv("BENCH_ROUNDS_N", "20"))
 NORTH_STAR_ROUNDS_PER_SEC = 5.0
 

@@ -222,25 +222,24 @@ class _TrainingSession:
         # assembled into global arrays over the whole mesh
         self.is_multiprocess = mesh is not None and jax.process_count() > 1
         if self.is_multiprocess and self.has_feature_axis:
+            # every process must own whole rows (all columns of its row
+            # shard) so host-local arrays assemble into the global 2-D
+            # layout; the feature axis therefore has to live within a host
+            local_feat = int(mesh.local_mesh.shape["feature"])
+            if local_feat != self.n_feature_shards:
+                raise exc.UserError(
+                    "The 'feature' mesh axis must not span processes: build "
+                    "the mesh with the data axis across hosts and the "
+                    "feature axis over each host's local devices."
+                )
+        if self.has_feature_axis and config.grow_policy == "lossguide":
             raise exc.UserError(
-                "feature-axis sharding across processes is not supported yet"
-            )
-        if self.has_feature_axis and (
-            config.colsample_bytree < 1.0
-            or config.colsample_bylevel < 1.0
-            or config.colsample_bynode < 1.0
-            or config.monotone_constraints
-            or config.interaction_constraints
-            or config.grow_policy == "lossguide"
-        ):
-            raise exc.UserError(
-                "feature-axis sharding does not support colsample/monotone/"
-                "interaction constraints or lossguide growth yet"
+                "feature-axis sharding does not support lossguide growth yet"
             )
         if self.is_multiprocess:
-            # local rows pad to a multiple of *local* devices; the global
-            # array is the concatenation over processes
-            self.pad_unit = max(1, len(mesh.local_devices))
+            # local rows pad to a multiple of the *local* data shards; the
+            # global array is the concatenation over processes
+            self.pad_unit = max(1, int(mesh.local_mesh.shape["data"]))
         else:
             self.pad_unit = self.n_data_shards
 
@@ -381,6 +380,7 @@ class _TrainingSession:
                 ],
                 axis=1,
             )
+        self._put = _put
         self.num_cuts = _put(num_cuts_np, self.feat_spec)
         self.bins = _put(bins_np, self.bins_spec)
         self.labels = _put(_layout_rows(labels, 0.0), P("data"))
@@ -516,7 +516,10 @@ class _TrainingSession:
         interaction_sets = None
         if cfg.interaction_constraints:
             d_cols = self.train_binned.num_col
-            sets_np = np.zeros((len(cfg.interaction_constraints), d_cols), bool)
+            # width = padded GLOBAL columns: with a feature axis the split
+            # ids crossing shards are global, and per-shard masks slice out
+            # their own column segment (tree_build._local_cols)
+            sets_np = np.zeros((len(cfg.interaction_constraints), self.d_pad), bool)
             for s, members in enumerate(cfg.interaction_constraints):
                 for f in members:
                     if 0 <= int(f) < d_cols:
@@ -536,9 +539,12 @@ class _TrainingSession:
             eta=effective_eta,
             max_delta_step=cfg.max_delta_step,
             colsample_bylevel=cfg.colsample_bylevel,
+            colsample_bynode=cfg.colsample_bynode,
             axis_name=axis_name,
             interaction_sets=interaction_sets,
             feature_axis_name=feature_axis,
+            n_feature_shards=self.n_feature_shards,
+            d_global=self.train_binned.num_col,
         )
         if cfg.grow_policy == "lossguide":
             from ..ops.lossguide import build_tree_lossguide
@@ -634,16 +640,28 @@ class _TrainingSession:
             # under shard_map and mismatch the per-shard margins)
             # lax.scan so the round body is compiled ONCE regardless of K
             k_features = max(1, int(round(colsample * d)))
+            d_pad = self.d_pad
+            n_fs = self.n_feature_shards
 
             def body(carry, j):
                 margins_c, extra = carry
                 rng_j = jax.random.fold_in(rng, j)
                 if colsample < 1.0:
-                    # same exactly-k-without-replacement draw as the host path
+                    # same exactly-k-without-replacement draw as the host
+                    # path, over GLOBAL columns; with a feature axis each
+                    # shard slices its own column segment of the one mask
                     chosen = jax.random.permutation(
                         jax.random.fold_in(rng_j, 777), d
                     )[:k_features]
-                    mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
+                    gmask = jnp.zeros(d_pad, jnp.float32).at[chosen].set(1.0)
+                    if feature_axis is not None:
+                        d_local = d_pad // n_fs
+                        fs = jax.lax.axis_index(feature_axis)
+                        mask = jax.lax.dynamic_slice(
+                            gmask, (fs * d_local,), (d_local,)
+                        )
+                    else:
+                        mask = gmask
                 else:
                     mask = feature_mask
                 packed, margins_c = one_round(
@@ -779,13 +797,23 @@ class _TrainingSession:
         metrics: [K, n_metrics] numpy when device metrics are active (batched
         mode); None when evaluation happens host-side (K=1)."""
         self.rng, sub, colrng = jax.random.split(self.rng, 3)
-        d = self.bins.shape[1]
+        d_pad = self.bins.shape[1]
         if self.config.colsample_bytree < 1.0:
-            k = max(1, int(round(self.config.colsample_bytree * d)))
-            chosen = jax.random.permutation(colrng, d)[:k]
-            feature_mask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
+            # draw k of the REAL columns (padded always-missing columns are
+            # never legal splits, but counting them would shrink k)
+            d_real = self.train_binned.num_col
+            k = max(1, int(round(self.config.colsample_bytree * d_real)))
+            chosen = np.asarray(jax.random.permutation(colrng, d_real)[:k])
+            mask_np = np.zeros(d_pad, np.float32)
+            mask_np[chosen] = 1.0
         else:
-            feature_mask = jnp.ones(d, jnp.float32)
+            mask_np = np.ones(d_pad, np.float32)
+        if self.has_feature_axis:
+            # the global mask is column-sharded over the feature axis; place
+            # it properly (required in multi-process runs)
+            feature_mask = self._put(mask_np, self.feat_spec)
+        else:
+            feature_mask = jnp.asarray(mask_np)
         args = (
             self.bins,
             self.margins,

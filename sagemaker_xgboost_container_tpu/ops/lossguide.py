@@ -56,6 +56,8 @@ def build_tree_lossguide(
     colsample_bynode=1.0,
     interaction_sets=None,
     feature_axis_name=None,
+    n_feature_shards=1,
+    d_global=None,
 ):
     """Grow one leaf-wise tree. Returns (tree arrays dict, row_out [n]).
 

@@ -66,8 +66,9 @@ def _forest_leaf_nodes_cat(
         cat = jnp.nan_to_num(v, nan=-1.0).astype(jnp.int32)
         # xgboost common::Decision: MISSING follows the default direction,
         # but an invalid (negative / out-of-range) category goes LEFT
-        # unconditionally
-        invalid = (cat < 0) | (cat >= max_cat)
+        # unconditionally. Negativity is checked on the FLOAT value:
+        # -0.5 truncates to int 0 but is still an invalid category.
+        invalid = (v < 0) | (cat >= max_cat)
         safe_cat = jnp.clip(cat, 0, max_cat - 1)
         word = cat_mask[t_idx, node, safe_cat >> 5]
         in_set = ((word >> (safe_cat & 31).astype(jnp.uint32)) & 1) == 1
@@ -82,32 +83,21 @@ def _forest_leaf_nodes_cat(
     return node
 
 
+def _stacked_args(stacked, *extra_keys):
+    """Common [T, N] traversal arrays (+ extras) as device arrays."""
+    keys = ("feature", "threshold", "default_left", "left", "right", "is_leaf")
+    return tuple(jnp.asarray(stacked[k]) for k in keys + extra_keys)
+
+
 def forest_leaf_nodes(stacked, x):
     """Dispatch: the plain numerical kernel, or the categorical-aware one
     when the stacked forest carries category bitmasks."""
+    x = jnp.asarray(x, jnp.float32)
     if "cat_split" in stacked:
         return _forest_leaf_nodes_cat(
-            jnp.asarray(stacked["feature"]),
-            jnp.asarray(stacked["threshold"]),
-            jnp.asarray(stacked["default_left"]),
-            jnp.asarray(stacked["left"]),
-            jnp.asarray(stacked["right"]),
-            jnp.asarray(stacked["is_leaf"]),
-            jnp.asarray(stacked["cat_split"]),
-            jnp.asarray(stacked["cat_mask"]),
-            jnp.asarray(x, jnp.float32),
-            stacked["depth"],
+            *_stacked_args(stacked, "cat_split", "cat_mask"), x, stacked["depth"]
         )
-    return _forest_leaf_nodes(
-        jnp.asarray(stacked["feature"]),
-        jnp.asarray(stacked["threshold"]),
-        jnp.asarray(stacked["default_left"]),
-        jnp.asarray(stacked["left"]),
-        jnp.asarray(stacked["right"]),
-        jnp.asarray(stacked["is_leaf"]),
-        jnp.asarray(x, jnp.float32),
-        stacked["depth"],
-    )
+    return _forest_leaf_nodes(*_stacked_args(stacked), x, stacked["depth"])
 
 
 @partial(jax.jit, static_argnames=("depth",))
@@ -141,30 +131,15 @@ def _forest_margin_cat(
 def forest_leaf_margins(stacked, x):
     """Per-tree leaf contributions [n, T]; one cached XLA program either way
     (categorical-aware when the stacked forest carries category bitmasks)."""
+    x = jnp.asarray(x, jnp.float32)
     if "cat_split" in stacked:
         return _forest_margin_cat(
-            jnp.asarray(stacked["feature"]),
-            jnp.asarray(stacked["threshold"]),
-            jnp.asarray(stacked["default_left"]),
-            jnp.asarray(stacked["left"]),
-            jnp.asarray(stacked["right"]),
-            jnp.asarray(stacked["is_leaf"]),
-            jnp.asarray(stacked["cat_split"]),
-            jnp.asarray(stacked["cat_mask"]),
-            jnp.asarray(stacked["leaf_value"]),
-            jnp.asarray(x, jnp.float32),
+            *_stacked_args(stacked, "cat_split", "cat_mask", "leaf_value"),
+            x,
             stacked["depth"],
         )
     return _forest_margin(
-        jnp.asarray(stacked["feature"]),
-        jnp.asarray(stacked["threshold"]),
-        jnp.asarray(stacked["default_left"]),
-        jnp.asarray(stacked["left"]),
-        jnp.asarray(stacked["right"]),
-        jnp.asarray(stacked["is_leaf"]),
-        jnp.asarray(stacked["leaf_value"]),
-        jnp.asarray(x, jnp.float32),
-        stacked["depth"],
+        *_stacked_args(stacked, "leaf_value"), x, stacked["depth"]
     )
 
 

@@ -57,6 +57,8 @@ def build_tree(
     colsample_bynode=1.0,
     interaction_sets=None,
     feature_axis_name=None,
+    n_feature_shards=1,
+    d_global=None,
 ):
     """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
 
@@ -92,13 +94,12 @@ def build_tree(
 
     # interaction constraints: per-node alive constraint sets. A feature is
     # usable in a node iff some still-alive set contains it; splitting on f
-    # keeps alive only the sets containing f (xgboost semantics).
+    # keeps alive only the sets containing f (xgboost semantics). With a
+    # feature axis, ``interaction_sets`` spans GLOBAL columns (split ids are
+    # global after cross-shard combination) and per-node masks are sliced to
+    # this shard's column segment.
     alive_sets = None
     if interaction_sets is not None:
-        if feature_axis_name is not None:
-            raise NotImplementedError(
-                "interaction_constraints with feature-axis sharding is unsupported"
-            )
         num_sets = interaction_sets.shape[0]
         alive_sets = jnp.ones((1, num_sets), jnp.bool_)
 
@@ -160,20 +161,43 @@ def build_tree(
             )
         if subtract:
             G_cache, H_cache = G, H
+        # Column draws are made over the REAL global feature count with the
+        # replicated rng (identical on every shard — and an identical
+        # threefry stream to the single-device build, which never pads), the
+        # mask is zero-padded to the padded global width, and each shard
+        # slices its own segment. A per-shard draw would silently
+        # decorrelate split choices across shards.
+        d_total = d * n_feature_shards
+        d_draw = int(d_global) if d_global is not None else d_total
+
+        def _pad_cols(mask_real):
+            if d_draw == d_total:
+                return mask_real
+            pad = [(0, 0)] * (mask_real.ndim - 1) + [(0, d_total - d_draw)]
+            return jnp.pad(mask_real, pad)
+
+        def _local_cols(mask_global):
+            if feature_axis_name is None:
+                return mask_global
+            start = (0,) * (mask_global.ndim - 1) + (feat_shard * d,)
+            sizes = mask_global.shape[:-1] + (d,)
+            return jax.lax.dynamic_slice(mask_global, start, sizes)
+
         level_mask = feature_mask
         if colsample_bylevel < 1.0 and rng is not None:
-            # fresh feature subset per level; identical on all shards (rng is
-            # replicated) so the chosen split is identical everywhere
-            draw = jax.random.uniform(jax.random.fold_in(rng, level), (d,))
-            sampled = (draw < colsample_bylevel).astype(jnp.float32)
+            draw = jax.random.uniform(jax.random.fold_in(rng, level), (d_draw,))
+            sampled = _local_cols(
+                _pad_cols((draw < colsample_bylevel).astype(jnp.float32))
+            )
             level_mask = sampled if level_mask is None else level_mask * sampled
         if colsample_bynode < 1.0 and rng is not None:
-            # fresh per-node feature subset (xgboost colsample_bynode);
-            # same rng on every shard -> identical draws everywhere
+            # fresh per-node feature subset (xgboost colsample_bynode)
             node_draw = jax.random.uniform(
-                jax.random.fold_in(rng, 7919 + level), (width, d)
+                jax.random.fold_in(rng, 7919 + level), (width, d_draw)
             )
-            node_mask = (node_draw < colsample_bynode).astype(jnp.float32)
+            node_mask = _local_cols(
+                _pad_cols((node_draw < colsample_bynode).astype(jnp.float32))
+            )
             if level_mask is None:
                 level_mask = node_mask
             elif level_mask.ndim == 1:
@@ -181,11 +205,12 @@ def build_tree(
             else:
                 level_mask = node_mask * level_mask
         if alive_sets is not None:
-            # [W, S] @ [S, d] -> per-node allowed-feature mask
+            # [W, S] @ [S, d_total] -> per-node allowed-feature mask over
+            # global columns, sliced to this shard
             node_allowed = (
                 alive_sets.astype(jnp.float32) @ interaction_sets.astype(jnp.float32)
             ) > 0
-            per_node = node_allowed.astype(jnp.float32)
+            per_node = _local_cols(node_allowed.astype(jnp.float32))
             level_mask = per_node if level_mask is None else per_node * level_mask[None, :]
         splits = find_best_splits(
             G,

@@ -217,3 +217,26 @@ def test_lossguide_predict_depth_adaptive():
     final_rmse = log["train"]["rmse"][-1]
     direct = float(np.sqrt(np.mean((forest.predict(X) - y) ** 2)))
     assert abs(final_rmse - direct) < 1e-4, (final_rmse, direct)
+
+
+def test_colsample_bynode_actually_wired():
+    """Regression: colsample_bynode must reach the tree builder through the
+    train() path (it was parsed but silently dropped from the builder
+    kwargs). An aggressive bynode setting must change the trees."""
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(17)
+    X = rng.rand(800, 8).astype(np.float32)
+    y = (X @ rng.rand(8).astype(np.float32)).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    base = {"max_depth": 4, "eta": 0.3, "seed": 5}
+    full = train(dict(base), dtrain, num_boost_round=3)
+    narrow = train(
+        dict(base, colsample_bynode=0.15), dtrain, num_boost_round=3
+    )
+    full_feats = np.concatenate([t.feature[~t.is_leaf] for t in full.trees])
+    narrow_feats = np.concatenate([t.feature[~t.is_leaf] for t in narrow.trees])
+    assert full_feats.shape != narrow_feats.shape or not np.array_equal(
+        full_feats, narrow_feats
+    ), "colsample_bynode had no effect on tree structure"

@@ -607,3 +607,97 @@ def test_mesh_colsample_matches_single_device(mesh8):
             single.predict(X), sharded.predict(X), rtol=1e-4, atol=1e-4,
             err_msg=str(extra),
         )
+
+
+@pytest.mark.multichip
+def test_2d_mesh_colsample_monotone_interaction():
+    """VERDICT r1 item 4: the (data x feature) mesh supports colsample /
+    monotone / interaction constraints — draws are made over GLOBAL columns
+    with the replicated rng, each shard slicing its own segment, so the 2-D
+    run equals single-device."""
+    from jax.sharding import Mesh as JMesh
+
+    X, y = _friedman(512, seed=23)
+    dtrain = DataMatrix(X, labels=y)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = JMesh(devices, axis_names=("data", "feature"))
+
+    for extra in (
+        {"colsample_bytree": 0.6},
+        {"colsample_bylevel": 0.6},
+        {"colsample_bynode": 0.6},
+        {"monotone_constraints": [1, 0, 0, 1, 0]},
+        {"interaction_constraints": [[0, 1], [2, 3, 4]]},
+    ):
+        params = {"max_depth": 4, "eta": 0.3, "seed": 11}
+        params.update(extra)
+        single = train(params, dtrain, num_boost_round=4)
+        sharded = train(params, dtrain, num_boost_round=4, mesh=mesh2d)
+        np.testing.assert_allclose(
+            single.predict(X), sharded.predict(X), rtol=1e-4, atol=1e-4,
+            err_msg=str(extra),
+        )
+
+
+@pytest.mark.multichip
+def test_2d_mesh_k_batched_metrics():
+    """K-batched device metrics on a 2-D mesh: stats psum over 'data' only,
+    replicated across 'feature' — lines equal the K=1 run."""
+    from jax.sharding import Mesh as JMesh
+
+    X, y = _friedman(512, seed=29)
+    dtrain = DataMatrix(X, labels=y)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = JMesh(devices, axis_names=("data", "feature"))
+
+    def run(extra):
+        log = {}
+
+        class Rec:
+            def after_iteration(self, model, epoch, evals_log):
+                log.update(
+                    {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+                )
+                return False
+
+        params = {"max_depth": 3, "eta": 0.3, "seed": 2}
+        params.update(extra)
+        train(params, dtrain, num_boost_round=6,
+              evals=[(dtrain, "train")], callbacks=[Rec()], mesh=mesh2d)
+        return log
+
+    k1 = run({})
+    k6 = run({"_rounds_per_dispatch": 6})
+    np.testing.assert_allclose(
+        k6["train"]["rmse"], k1["train"]["rmse"], rtol=2e-4, atol=2e-5
+    )
+
+
+@pytest.mark.multichip
+def test_two_process_2d_mesh_training():
+    """2-process x (2 data x 2 feature) pod: column-sharded split finding
+    with colsample/monotone active; both hosts produce identical models and
+    the model actually learns."""
+    import multiprocessing as mp
+
+    from tests.util_multiprocess import distributed_2d_mesh_worker
+    from tests.util_ports import free_port
+
+    port = free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=distributed_2d_mesh_worker, args=(r, 2, port, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(2):
+        rank, preds = q.get(timeout=300)
+        results[rank] = preds
+    for p in procs:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
+    assert np.std(results[0]) > 0.1  # learned from combined data

@@ -137,3 +137,53 @@ def distributed_metrics_worker(rank, world, port, q):
         -np.mean(y * np.log(p3) + (1 - y) * np.log(1 - p3))
     )
     q.put((rank, dev_log, host_log, check))
+
+
+def distributed_2d_mesh_worker(rank, world, port, q):
+    """2 processes x (2 data x 2 feature) mesh: the data axis spans hosts,
+    the feature axis stays within each host (VERDICT r1 item 4). Trains with
+    colsample + monotone active."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world,
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(800, 5).astype(np.float32)
+    y = (3 * X[:, 0] + np.sin(5 * X[:, 1]) + X[:, 3]).astype(np.float32)
+    half = 400
+    lo, hi = rank * half, (rank + 1) * half
+    dtrain = DataMatrix(X[lo:hi], labels=y[lo:hi])
+
+    devices = np.array(jax.devices()).reshape(2, 2)  # [data, feature]
+    mesh = Mesh(devices, axis_names=("data", "feature"))
+
+    forest = train(
+        {
+            "max_depth": 3,
+            "eta": 0.3,
+            "max_bin": 64,
+            "seed": 1,
+            "colsample_bylevel": 0.7,
+            "monotone_constraints": [1, 0, 0, 0, 0],
+        },
+        dtrain,
+        num_boost_round=6,
+        mesh=mesh,
+    )
+    preds = forest.predict(X[:64])
+    q.put((rank, np.asarray(preds)))

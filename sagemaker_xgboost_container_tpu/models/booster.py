@@ -50,6 +50,18 @@ except ImportError:  # older jax
 
 logger = logging.getLogger(__name__)
 
+# objective hyperparameters carried into the saved model / objective
+# construction (shared by train() and the fold-parallel CV path)
+OBJECTIVE_PARAM_KEYS = (
+    "scale_pos_weight",
+    "tweedie_variance_power",
+    "huber_slope",
+    "max_delta_step",
+    "num_class",
+    "aft_loss_distribution",
+    "aft_loss_distribution_scale",
+)
+
 
 class TrainConfig:
     """Parsed + defaulted booster parameters (static across rounds)."""
@@ -247,12 +259,20 @@ class _TrainingSession:
         self.objective.validate_labels(labels)
 
         self.is_ranking = getattr(self.objective, "needs_groups", False)
-        if self.objective.name == "survival:cox" and mesh is not None:
-            # Cox risk sets span the whole dataset; shard-local
-            # argsort/cumsum would silently compute wrong gradients
+        if (
+            self.objective.name == "survival:cox"
+            and mesh is not None
+            and jax.process_count() > 1
+            and evals
+        ):
+            # training gradients are exact (global risk sets via all_gather)
+            # but cox-nloglik is not decomposable, so multi-host watchlist
+            # lines would be a biased per-host average — refuse loudly
+            # rather than print wrong numbers
             raise exc.UserError(
-                "Distributed training for survival:cox is not supported yet; "
-                "run Cox regression jobs on a single host."
+                "survival:cox eval metrics are not supported in multi-host "
+                "training yet (the partial likelihood does not decompose "
+                "across hosts); drop the watchlist or train single-host."
             )
         # ranking layouts: single device keeps the [G, M] global layout;
         # on a mesh, rows are re-partitioned BY GROUP (groups never straddle
@@ -559,6 +579,29 @@ class _TrainingSession:
             builder = partial(build_tree, max_depth=cfg.max_depth, **common)
         ranking_grads = self._grad_hess_fn()
         grad_hess = self.objective.grad_hess
+        if self.objective.name == "survival:cox" and axis_name is not None:
+            # Cox risk sets span the WHOLE dataset (cumulative sums over the
+            # global time ordering), so shard-local gradients would be
+            # silently wrong. Exact distributed form: all_gather the margin/
+            # label/weight shards over the data axis inside the jitted round,
+            # compute global gradients (replicated — padding rows carry
+            # weight 0 and drop out), and slice this shard's row segment.
+            # This is exact where the reference's per-worker Cox is not.
+            base_grad_hess = grad_hess
+
+            def cox_mesh_grad_hess(m, y, w):
+                M = jax.lax.all_gather(m, axis_name, tiled=True)
+                Y = jax.lax.all_gather(y, axis_name, tiled=True)
+                Wt = jax.lax.all_gather(w, axis_name, tiled=True)
+                G, H = base_grad_hess(M, Y, Wt)
+                k = jax.lax.axis_index(axis_name)
+                c = m.shape[0]
+                return (
+                    jax.lax.dynamic_slice(G, (k * c,), (c,)),
+                    jax.lax.dynamic_slice(H, (k * c,), (c,)),
+                )
+
+            grad_hess = cox_mesh_grad_hess
         num_group = self.num_group
         subsample = cfg.subsample
         num_parallel = cfg.num_parallel_tree
@@ -1034,14 +1077,7 @@ def train(
             objective_params={
                 k: v
                 for k, v in config.objective_params.items()
-                if k
-                in (
-                    "scale_pos_weight",
-                    "tweedie_variance_power",
-                    "huber_slope",
-                    "max_delta_step",
-                    "num_class",
-                )
+                if k in OBJECTIVE_PARAM_KEYS
             },
             base_score=config.base_score,
             num_feature=dtrain.num_col,

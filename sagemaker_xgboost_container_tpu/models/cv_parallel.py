@@ -1,0 +1,210 @@
+"""Fold-parallel k-fold CV: train all folds simultaneously across devices.
+
+The reference trains k-fold CV strictly sequentially (k x r full boosting
+runs, algorithm_mode/train.py:378-459) because libxgboost owns one process.
+On TPU the folds are embarrassingly parallel and tiny relative to a chip:
+every fold is the SAME dataset with a different row-weight mask (held-out
+rows carry weight 0 and drop out of histograms and metrics identically to
+xgboost's row slicing), so one ``vmap`` over the fold axis trains all folds
+in a single XLA program, and sharding that axis over a ``Mesh`` spreads
+folds across devices with zero collectives (SURVEY.md §2.3 row 5's
+"opportunity" column).
+
+Scope: single-process, gbtree, depthwise growth, single output group,
+num_parallel_tree=1, device-decomposable metrics. The orchestration layer
+falls back to the sequential path otherwise.
+
+Binning note: quantile cut points are computed ONCE over the full
+train+validation matrix (feature values + weights only — no labels, so no
+label leakage), where the sequential path re-sketches each fold's training
+slice. This is standard unsupervised preprocessing, but it means the two
+paths can produce slightly different trees/metric lines for skewed
+features; ``GRAFT_PARALLEL_CV=0`` forces the sequential behavior.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.binning import bin_matrix
+from ..ops.tree_build import build_tree, pack_tree, unpack_tree
+from .device_metrics import all_supported
+from .forest import Forest, compact_padded_tree
+
+logger = logging.getLogger(__name__)
+
+
+def parallel_cv_supported(config, metric_names, has_feval):
+    """Static eligibility for the fold-parallel path."""
+    if has_feval or not metric_names:
+        return False
+    if config.booster != "gbtree" or config.grow_policy != "depthwise":
+        return False
+    if config.num_class > 1 or config.num_parallel_tree > 1:
+        return False
+    if config.objective.startswith("rank:") or config.objective == "survival:cox":
+        return False
+    if config.rounds_per_dispatch < 1:
+        return False
+    fns = all_supported(
+        metric_names, config.objective, 1, config.objective_params
+    )
+    return fns is not None
+
+
+def train_cv_parallel(
+    config, dmatrix, splits, num_boost_round, metric_names, forest_factory
+):
+    """Train len(splits) folds in parallel. Returns (forests, evals_results).
+
+    splits: [(train_idx, val_idx)] over dmatrix rows; evals_results matches
+    the sequential recorder shape: per fold {"train": {m: [v...]},
+    "validation": {m: [v...]}}.
+    """
+    K = len(splits)
+    devices = jax.devices()
+    F = min(len(devices), K)
+    K_pad = -(-K // F) * F
+
+    binned = bin_matrix(dmatrix, config.max_bin)
+    n, d = binned.bins.shape
+    num_bins = binned.num_bins
+    labels = np.asarray(dmatrix.labels, np.float32)
+    base_w = np.asarray(dmatrix.get_weight(), np.float32)
+
+    train_w = np.zeros((K_pad, n), np.float32)
+    val_w = np.zeros((K_pad, n), np.float32)
+    for k, (tr_idx, va_idx) in enumerate(splits):
+        train_w[k, tr_idx] = base_w[tr_idx]
+        val_w[k, va_idx] = base_w[va_idx]
+
+    proto = forest_factory()
+    objective = proto.objective()
+    base = objective.base_margin(proto.base_score)
+    metric_fns = all_supported(
+        metric_names, config.objective, 1, config.objective_params
+    )
+
+    mesh = Mesh(np.array(devices[:F]), axis_names=("fold",))
+    fold_sharding = NamedSharding(mesh, P("fold"))
+    repl = NamedSharding(mesh, P())
+
+    bins_dev = jax.device_put(binned.bins.astype(np.int32), repl)
+    labels_dev = jax.device_put(labels, repl)
+    num_cuts_dev = jax.device_put(
+        np.array([len(c) for c in binned.cut_points], np.int32), repl
+    )
+    train_w_dev = jax.device_put(train_w, fold_sharding)
+    val_w_dev = jax.device_put(val_w, fold_sharding)
+    margins_dev = jax.device_put(
+        np.full((K_pad, n), base, np.float32), fold_sharding
+    )
+
+    grad_hess = objective.grad_hess
+    cfg = config
+    monotone = None
+    if cfg.monotone_constraints:
+        vals = np.asarray(cfg.monotone_constraints, np.int32)
+        mono_np = np.zeros(d, np.int32)
+        mono_np[: len(vals)] = vals
+        monotone = jnp.asarray(mono_np)
+    interaction_sets = None
+    if cfg.interaction_constraints:
+        sets_np = np.zeros((len(cfg.interaction_constraints), d), bool)
+        for s, members in enumerate(cfg.interaction_constraints):
+            for f in members:
+                if 0 <= int(f) < d:
+                    sets_np[s, int(f)] = True
+        interaction_sets = jnp.asarray(sets_np)
+
+    k_rounds = max(1, cfg.rounds_per_dispatch)
+
+    def fold_round(bins, margins_k, tw_k, vw_k, rng_k):
+        g, h = grad_hess(margins_k, labels_dev, tw_k)
+        if cfg.subsample < 1.0:
+            keep = (
+                jax.random.uniform(jax.random.fold_in(rng_k, 13), (n,))
+                < cfg.subsample
+            ).astype(jnp.float32)
+            g, h = g * keep, h * keep
+        if cfg.colsample_bytree < 1.0:
+            kf = max(1, int(round(cfg.colsample_bytree * d)))
+            chosen = jax.random.permutation(jax.random.fold_in(rng_k, 777), d)[:kf]
+            fmask = jnp.zeros(d, jnp.float32).at[chosen].set(1.0)
+        else:
+            fmask = jnp.ones(d, jnp.float32)
+        tree, row_out = build_tree(
+            bins, g, h, num_cuts_dev,
+            max_depth=cfg.max_depth,
+            num_bins=num_bins,
+            reg_lambda=cfg.reg_lambda,
+            alpha=cfg.alpha,
+            gamma=cfg.gamma,
+            min_child_weight=cfg.min_child_weight,
+            eta=cfg.eta,
+            max_delta_step=cfg.max_delta_step,
+            feature_mask=fmask,
+            monotone=monotone,
+            rng=rng_k,
+            colsample_bylevel=cfg.colsample_bylevel,
+            colsample_bynode=cfg.colsample_bynode,
+            interaction_sets=interaction_sets,
+        )
+        margins_k = margins_k + row_out
+        stats = []
+        for fn in metric_fns:
+            stats.append(fn.finalize(fn.partial(margins_k, labels_dev, tw_k)))
+            stats.append(fn.finalize(fn.partial(margins_k, labels_dev, vw_k)))
+        return pack_tree(tree), margins_k, jnp.stack(stats)
+
+    def dispatch(margins, rng):
+        def body(carry, j):
+            m = carry
+            rng_j = jax.random.fold_in(rng, j)
+            per_fold = jax.vmap(
+                lambda mk, tw, vw, i: fold_round(
+                    bins_dev, mk, tw, vw, jax.random.fold_in(rng_j, i)
+                )
+            )(m, train_w_dev, val_w_dev, jnp.arange(K_pad))
+            packed, m, stats = per_fold
+            return m, (packed, stats)
+
+        margins, (packed_all, stats_all) = jax.lax.scan(
+            body, margins, jnp.arange(k_rounds)
+        )
+        return margins, packed_all, stats_all
+
+    dispatch_jit = jax.jit(dispatch, donate_argnums=(0,))
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    forests = [forest_factory() for _ in range(K)]
+    evals_results = [
+        {"train": {m: [] for m in metric_names},
+         "validation": {m: [] for m in metric_names}}
+        for _ in range(K)
+    ]
+    cuts = binned.cut_points
+    rnd = 0
+    while rnd < num_boost_round:
+        rng, sub = jax.random.split(rng)
+        margins_dev, packed_all, stats_all = dispatch_jit(margins_dev, sub)
+        packed_np = np.asarray(packed_all)     # [R, K_pad, ...]
+        stats_np = np.asarray(stats_all)       # [R, K_pad, 2*n_metrics]
+        for j in range(packed_np.shape[0]):
+            if rnd >= num_boost_round:
+                break
+            for k in range(K):
+                tree_np = unpack_tree(packed_np[j, k])
+                forests[k].append_round(
+                    [compact_padded_tree(tree_np, cuts)], [0]
+                )
+                for i, m in enumerate(metric_names):
+                    evals_results[k]["train"][m].append(float(stats_np[j, k, 2 * i]))
+                    evals_results[k]["validation"][m].append(
+                        float(stats_np[j, k, 2 * i + 1])
+                    )
+            rnd += 1
+    return forests, evals_results

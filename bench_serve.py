@@ -62,21 +62,40 @@ def main():
             resp.read()
         return time.perf_counter() - t0
 
+    import jax
+
     single = ",".join("%.4f" % v for v in X[0]).encode()
-    post(single)  # warm the jit cache
-    lat = sorted(post(single) for _ in range(N_REQUESTS))
     batch = "\n".join(
         ",".join("%.4f" % v for v in row) for row in X[:256]
     ).encode()
+
+    # A/B the small-payload strategy: host numpy traversal (pinned to a
+    # cutover that definitely includes 1 row) vs forcing the compiled device
+    # kernel; the operator's own env value is restored for the batch leg
+    prior = os.environ.get("GRAFT_HOST_PREDICT_ROWS")
+    results = {}
+    for label, rows in (("host", "32"), ("device", "0")):
+        os.environ["GRAFT_HOST_PREDICT_ROWS"] = rows
+        post(single)  # warm (jit cache on the device side)
+        lat = sorted(post(single) for _ in range(N_REQUESTS))
+        results["p50_single_row_ms_" + label] = round(lat[len(lat) // 2] * 1000, 2)
+        results["p99_single_row_ms_" + label] = round(
+            lat[int(len(lat) * 0.99) - 1] * 1000, 2
+        )
+    if prior is None:
+        del os.environ["GRAFT_HOST_PREDICT_ROWS"]
+    else:
+        os.environ["GRAFT_HOST_PREDICT_ROWS"] = prior
     post(batch)
     blat = sorted(post(batch) for _ in range(50))
     httpd.shutdown()
     print(
         json.dumps(
             {
-                "metric": "serve /invocations latency (100-tree depth-6 model)",
-                "p50_single_row_ms": round(lat[len(lat) // 2] * 1000, 2),
-                "p99_single_row_ms": round(lat[int(len(lat) * 0.99) - 1] * 1000, 2),
+                "metric": "serve /invocations latency (100-tree depth-6 model) [backend={}]".format(
+                    jax.default_backend()
+                ),
+                **results,
                 "p50_batch256_ms": round(blat[len(blat) // 2] * 1000, 2),
                 "unit": "ms",
             }

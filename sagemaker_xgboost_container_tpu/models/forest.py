@@ -17,9 +17,19 @@ import json
 
 import numpy as np
 
-from ..ops.predict import forest_predict_margin
+from ..ops.predict import forest_predict_margin, host_predict_margin
 from ..toolkit import exceptions as exc
 from . import objectives as objectives_mod
+
+
+def _host_predict_rows():
+    """Row-count cutover below which prediction runs the numpy host path
+    instead of the compiled device kernel (0 disables). Default 32: at that
+    size host traversal is still ~100us while a device dispatch is >=1ms on
+    a tunneled TPU (bench_serve.py measures both sides of the cutover)."""
+    import os
+
+    return int(os.environ.get("GRAFT_HOST_PREDICT_ROWS", "32"))
 
 
 class Tree:
@@ -272,6 +282,17 @@ class Forest:
             if self.num_output_group == 1:
                 return np.full(n, base, np.float32)
             return np.full((n, self.num_output_group), base, np.float32)
+        if 0 < n <= _host_predict_rows():
+            # tiny payloads skip the device entirely: the per-dispatch floor
+            # (host<->device transfer; a network round trip on tunneled TPUs)
+            # dwarfs microseconds of traversal. Threshold: GRAFT_HOST_PREDICT_ROWS.
+            return host_predict_margin(
+                stacked,
+                np.ascontiguousarray(features, np.float32),
+                num_output_group=self.num_output_group,
+                base_margin=base,
+                tree_info=self.tree_info[tree_lo:tree_hi],
+            )
         # bucket the row count to a power of two so serving payloads of
         # varying size share jit-compiled kernels instead of recompiling
         n_pad = max(8, 1 << (int(n - 1).bit_length())) if n else 8

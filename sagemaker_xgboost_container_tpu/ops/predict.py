@@ -18,23 +18,55 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnames=("depth",))
-def _forest_leaf_nodes(feature, threshold, default_left, left, right, is_leaf, x, depth):
-    """x: f32 [n, d] (NaN = missing) -> leaf node index per (row, tree)."""
+def _leaf_nodes_impl(
+    xp, feature, threshold, default_left, left, right, is_leaf, x, depth,
+    cat_split=None, cat_mask=None,
+):
+    """The ONE traversal implementation, parameterized by array namespace
+    (``xp`` = jnp for the jitted device kernels, np for the host small-payload
+    path) so the routing rules cannot diverge between them.
+
+    Rules (xgboost semantics): NaN-missing follows ``default_left``;
+    numerical nodes go right when ``v >= threshold``; categorical nodes
+    (cat_split/cat_mask given; xgboost common::Decision) go right when the
+    int category is in the node's bitmask, while an invalid category
+    (negative float / out-of-range) goes LEFT unconditionally — negativity
+    is checked on the FLOAT value: -0.5 truncates to int 0 but is still
+    invalid. Leaves self-loop via left/right == own index.
+    """
     n = x.shape[0]
     T = feature.shape[0]
-    node = jnp.zeros((n, T), jnp.int32)
-    t_idx = jnp.arange(T)[None, :]
+    node = xp.zeros((n, T), xp.int32)
+    t_idx = xp.broadcast_to(xp.arange(T)[None, :], (n, T))
+    if cat_mask is not None:
+        max_cat = cat_mask.shape[2] * 32
 
     for _ in range(depth):
         feat = feature[t_idx, node]            # [n, T]
         thr = threshold[t_idx, node]
-        v = jnp.take_along_axis(x, feat.reshape(n, -1), axis=1).reshape(n, T)
-        miss = jnp.isnan(v)
-        go_right = jnp.where(miss, ~default_left[t_idx, node], v >= thr)
-        nxt = jnp.where(go_right, right[t_idx, node], left[t_idx, node])
-        node = jnp.where(is_leaf[t_idx, node], node, nxt)
+        v = xp.take_along_axis(x, feat.reshape(n, -1), axis=1).reshape(n, T)
+        miss = xp.isnan(v)
+        dfl = default_left[t_idx, node]
+        go_right = xp.where(miss, ~dfl, v >= thr)
+        if cat_mask is not None:
+            cat = xp.nan_to_num(v, nan=-1.0).astype(xp.int32)
+            invalid = (v < 0) | (cat >= max_cat)
+            safe_cat = xp.clip(cat, 0, max_cat - 1)
+            word = cat_mask[t_idx, node, safe_cat >> 5]
+            in_set = ((word >> (safe_cat & 31).astype(xp.uint32)) & 1) == 1
+            go_right_cat = xp.where(miss, ~dfl, xp.where(invalid, False, in_set))
+            go_right = xp.where(cat_split[t_idx, node], go_right_cat, go_right)
+        nxt = xp.where(go_right, right[t_idx, node], left[t_idx, node])
+        node = xp.where(is_leaf[t_idx, node], node, nxt)
     return node
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _forest_leaf_nodes(feature, threshold, default_left, left, right, is_leaf, x, depth):
+    """x: f32 [n, d] (NaN = missing) -> leaf node index per (row, tree)."""
+    return _leaf_nodes_impl(
+        jnp, feature, threshold, default_left, left, right, is_leaf, x, depth
+    )
 
 
 @partial(jax.jit, static_argnames=("depth",))
@@ -42,45 +74,11 @@ def _forest_leaf_nodes_cat(
     feature, threshold, default_left, left, right, is_leaf,
     cat_split, cat_mask, x, depth,
 ):
-    """Traversal with partition-based categorical nodes (BYO xgboost models).
-
-    cat_split: bool [T, N] — node is categorical; cat_mask: u32 [T, N, W]
-    bitmask of the categories routed RIGHT (xgboost common::Decision:
-    in-set -> right; invalid/missing -> default direction). The numerical
-    path is identical to _forest_leaf_nodes.
-    """
-    n = x.shape[0]
-    T = feature.shape[0]
-    W = cat_mask.shape[2]
-    max_cat = W * 32
-    node = jnp.zeros((n, T), jnp.int32)
-    t_idx = jnp.broadcast_to(jnp.arange(T)[None, :], (n, T))
-
-    for _ in range(depth):
-        feat = feature[t_idx, node]            # [n, T]
-        thr = threshold[t_idx, node]
-        v = jnp.take_along_axis(x, feat.reshape(n, -1), axis=1).reshape(n, T)
-        miss = jnp.isnan(v)
-        dfl = default_left[t_idx, node]
-
-        cat = jnp.nan_to_num(v, nan=-1.0).astype(jnp.int32)
-        # xgboost common::Decision: MISSING follows the default direction,
-        # but an invalid (negative / out-of-range) category goes LEFT
-        # unconditionally. Negativity is checked on the FLOAT value:
-        # -0.5 truncates to int 0 but is still an invalid category.
-        invalid = (v < 0) | (cat >= max_cat)
-        safe_cat = jnp.clip(cat, 0, max_cat - 1)
-        word = cat_mask[t_idx, node, safe_cat >> 5]
-        in_set = ((word >> (safe_cat & 31).astype(jnp.uint32)) & 1) == 1
-        go_right_cat = jnp.where(
-            miss, ~dfl, jnp.where(invalid, False, in_set)
-        )
-
-        go_right_num = jnp.where(miss, ~dfl, v >= thr)
-        go_right = jnp.where(cat_split[t_idx, node], go_right_cat, go_right_num)
-        nxt = jnp.where(go_right, right[t_idx, node], left[t_idx, node])
-        node = jnp.where(is_leaf[t_idx, node], node, nxt)
-    return node
+    """Traversal with partition-based categorical nodes (BYO xgboost models)."""
+    return _leaf_nodes_impl(
+        jnp, feature, threshold, default_left, left, right, is_leaf, x, depth,
+        cat_split=cat_split, cat_mask=cat_mask,
+    )
 
 
 def _stacked_args(stacked, *extra_keys):
@@ -158,4 +156,45 @@ def forest_predict_margin(stacked, x, num_output_group=1, base_margin=0.0, tree_
     info = np.asarray(tree_info)
     for c in range(num_output_group):
         out[:, c] = leaf_np[:, info == c].sum(axis=1) + base_margin
+    return out
+
+
+# ------------------------------------------------------------- host predictor
+
+
+def host_leaf_nodes(stacked, x):
+    """Numpy twin of the XLA traversal for tiny serving payloads.
+
+    A 1-row `/invocations` on TPU pays the full host->device->host dispatch
+    (and, under a tunneled chip, a network round trip) for microseconds of
+    compute; the reference's C++ predictor (serve_utils.py:244-250) has no
+    such floor. Rows below ``Forest``'s host-path threshold therefore run
+    ``_leaf_nodes_impl`` with xp=np — the same code the jitted kernels run,
+    so the routing rules cannot diverge.
+    """
+    x = np.asarray(x, np.float32)
+    keys = ("feature", "threshold", "default_left", "left", "right", "is_leaf")
+    arrays = tuple(np.asarray(stacked[k]) for k in keys)
+    cat = {}
+    if "cat_split" in stacked:
+        cat = {
+            "cat_split": np.asarray(stacked["cat_split"]),
+            "cat_mask": np.asarray(stacked["cat_mask"]),
+        }
+    return _leaf_nodes_impl(np, *arrays, x, int(stacked["depth"]), **cat)
+
+
+def host_predict_margin(stacked, x, num_output_group=1, base_margin=0.0, tree_info=None):
+    """Numpy forest margin for tiny payloads (same contract as
+    ``forest_predict_margin``, no device dispatch, no padding needed)."""
+    node = host_leaf_nodes(stacked, x)
+    leaf_value = np.asarray(stacked["leaf_value"])
+    T = leaf_value.shape[0]
+    leaf = leaf_value[np.arange(T)[None, :], node]       # [n, T]
+    if num_output_group == 1:
+        return leaf.sum(axis=1) + base_margin
+    out = np.zeros((x.shape[0], num_output_group), np.float32)
+    info = np.asarray(tree_info)
+    for c in range(num_output_group):
+        out[:, c] = leaf[:, info == c].sum(axis=1) + base_margin
     return out

@@ -27,9 +27,9 @@ def _host_predict_rows():
     instead of the compiled device kernel (0 disables). Default 32: at that
     size host traversal is still ~100us while a device dispatch is >=1ms on
     a tunneled TPU (bench_serve.py measures both sides of the cutover)."""
-    import os
+    from ..utils.envconfig import env_int
 
-    return int(os.environ.get("GRAFT_HOST_PREDICT_ROWS", "32"))
+    return env_int("GRAFT_HOST_PREDICT_ROWS", 32)
 
 
 class Tree:

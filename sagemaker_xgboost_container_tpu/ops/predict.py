@@ -49,8 +49,11 @@ def _leaf_nodes_impl(
         dfl = default_left[t_idx, node]
         go_right = xp.where(miss, ~dfl, v >= thr)
         if cat_mask is not None:
+            # range checks on the FLOAT value: float->int32 of values >= 2^31
+            # wraps on numpy but saturates on XLA:TPU, so an int-side
+            # comparison would diverge between the host and device paths
+            invalid = (v < 0) | (v >= max_cat)
             cat = xp.nan_to_num(v, nan=-1.0).astype(xp.int32)
-            invalid = (v < 0) | (cat >= max_cat)
             safe_cat = xp.clip(cat, 0, max_cat - 1)
             word = cat_mask[t_idx, node, safe_cat >> 5]
             in_set = ((word >> (safe_cat & 31).astype(xp.uint32)) & 1) == 1

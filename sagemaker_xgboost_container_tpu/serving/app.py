@@ -113,12 +113,12 @@ def parse_accept(environ):
     return accept
 
 
-def _read_body(environ):
+def _read_body(environ, limit=None):
     try:
         length = int(environ.get("CONTENT_LENGTH") or 0)
     except ValueError:
         length = 0
-    if length > PARSED_MAX_CONTENT_LENGTH:
+    if length > (PARSED_MAX_CONTENT_LENGTH if limit is None else limit):
         raise exc.UserError("Payload too large")
     return environ["wsgi.input"].read(length) if length else b""
 

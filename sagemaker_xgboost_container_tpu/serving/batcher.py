@@ -31,25 +31,41 @@ class _Pending:
         self.error = None
 
 
+class JobQueueFull(Exception):
+    """Raised when the bounded job queue rejects a request (the MMS analog:
+    SAGEMAKER_MODEL_JOB_QUEUE_SIZE, reference serving_mms.py:100 — MMS
+    returns 503 when a model's job queue is exhausted)."""
+
+
 class PredictBatcher:
     """Coalesce predict calls into batched kernel dispatches.
 
     ``predict_fn(features) -> np.ndarray`` must be thread-safe (ours is: a
     pure jitted kernel). ``max_batch_rows`` bounds padding waste;
-    ``max_wait_ms`` bounds added latency under low load.
+    ``max_wait_ms`` bounds added latency under low load; ``max_queue``
+    (None = unbounded) bounds in-flight requests, rejecting beyond it.
     """
 
-    def __init__(self, predict_fn, max_batch_rows=16384, max_wait_ms=2.0):
+    def __init__(self, predict_fn, max_batch_rows=16384, max_wait_ms=2.0, max_queue=None):
         self.predict_fn = predict_fn
         self.max_batch_rows = max_batch_rows
         self.max_wait_ms = max_wait_ms
-        self._queue = queue.Queue()
+        self.max_queue = max_queue
+        # bounded queue -> the limit is atomic (put_nowait raises Full);
+        # a qsize() check-then-put would race under concurrent WSGI threads
+        self._queue = queue.Queue(maxsize=max_queue or 0)
+        self._carry = None  # width-mismatched request deferred to next batch
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
     def predict(self, features, timeout=60.0):
         pending = _Pending(np.asarray(features, np.float32))
-        self._queue.put(pending)
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            raise JobQueueFull(
+                "job queue full ({} pending)".format(self.max_queue)
+            )
         if not pending.event.wait(timeout):
             raise TimeoutError("prediction timed out in the batch queue")
         if pending.error is not None:
@@ -67,8 +83,9 @@ class PredictBatcher:
             except queue.Empty:
                 break
             if nxt.features.shape[1] != first.features.shape[1]:
-                # different width (e.g. mid-flight model swap): run separately
-                self._queue.put(nxt)
+                # different width (e.g. mid-flight model swap): defer to its
+                # own batch (re-putting could block on a bounded queue)
+                self._carry = nxt
                 break
             batch.append(nxt)
             rows += nxt.features.shape[0]
@@ -76,7 +93,10 @@ class PredictBatcher:
 
     def _worker(self):
         while True:
-            first = self._queue.get()
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                first = self._queue.get()
             batch = self._drain_batch(first)
             try:
                 stacked = (

@@ -14,6 +14,18 @@ test/integration/local/test_multiple_model_endpoint.py:32-101):
 
 Loaded models hold compiled predict kernels; an LRU cap (env
 ``SAGEMAKER_MAX_MODELS``, default unlimited) evicts the coldest model.
+
+Operational knobs, mirroring the reference's MMS sizing contract
+(serving_mms.py:72-137):
+
+* ``SAGEMAKER_MAX_REQUEST_SIZE`` / ``MAX_CONTENT_LENGTH`` — payload cap,
+  default 6MB, hard-capped at MMS's 20MB limit (serving_mms.py:34-35).
+* ``SAGEMAKER_MODEL_JOB_QUEUE_SIZE`` — per-model pending-request bound
+  (default 100, serving_mms.py:37); beyond it invokes get 503.
+* ``SAGEMAKER_NUM_MODEL_WORKERS`` — accepted for contract parity; compute
+  concurrency on a single-TPU-owner architecture comes from the request
+  coalescer, not worker processes, so values other than 1 only log.
+* JVM heap knobs (SAGEMAKER_MAX_HEAP_SIZE etc.) have no analog — no JVM.
 """
 
 import collections
@@ -24,9 +36,27 @@ import os
 import threading
 
 from . import serve_utils
+from ..toolkit import exceptions as exc
+from ..utils.envconfig import env_int
 from .app import _read_body, _response, parse_accept
+from .batcher import JobQueueFull
 
 logger = logging.getLogger(__name__)
+
+MAX_CONTENT_LEN_LIMIT = 20 * 1024**2  # MMS hard cap, reference serving_mms.py:35
+
+
+def _max_request_size():
+    """Payload cap: SAGEMAKER_MAX_REQUEST_SIZE, else MAX_CONTENT_LENGTH,
+    else 6MB — hard-capped at MMS's 20MB (reference serving_mms.py:80-83)."""
+    value = env_int(
+        "SAGEMAKER_MAX_REQUEST_SIZE", env_int("MAX_CONTENT_LENGTH", 6 * 1024**2)
+    )
+    return min(value, MAX_CONTENT_LEN_LIMIT)
+
+
+def _job_queue_size():
+    return env_int("SAGEMAKER_MODEL_JOB_QUEUE_SIZE", 100)
 
 
 class ModelManager:
@@ -48,7 +78,15 @@ class ModelManager:
 
             rng = serve_utils.best_iteration_range(model)
             batcher = PredictBatcher(
-                lambda feats, _m=model, _r=rng: _m.predict(feats, iteration_range=_r)
+                lambda feats, _m=model, _r=rng: _m.predict(feats, iteration_range=_r),
+                max_queue=_job_queue_size(),
+            )
+        workers = os.getenv("SAGEMAKER_NUM_MODEL_WORKERS")
+        if workers and workers != "1":
+            logger.info(
+                "SAGEMAKER_NUM_MODEL_WORKERS=%s accepted; concurrency on a "
+                "single-TPU-owner endpoint comes from request coalescing",
+                workers,
             )
         with self._lock:
             if name in self._models:
@@ -176,7 +214,10 @@ def _invoke(manager, name, environ, start_response):
         model, fmt, _dir, batcher = manager.get(name)
     except KeyError:
         return _response(start_response, http.client.NOT_FOUND, "model not found")
-    payload = _read_body(environ)
+    try:
+        payload = _read_body(environ, limit=_max_request_size())
+    except exc.UserError as e:
+        return _response(start_response, http.client.REQUEST_ENTITY_TOO_LARGE, str(e))
     if not payload:
         return _response(start_response, http.client.NO_CONTENT)
     content_type = environ.get("CONTENT_TYPE", "text/csv")
@@ -199,6 +240,8 @@ def _invoke(manager, name, environ, start_response):
             preds = serve_utils.predict(
                 model, fmt, dtest, parsed_type, objective=first.objective_name
             )
+    except JobQueueFull as e:
+        return _response(start_response, http.client.SERVICE_UNAVAILABLE, str(e))
     except Exception as e:
         logger.exception("invoke predict failed")
         return _response(start_response, http.client.BAD_REQUEST, str(e))

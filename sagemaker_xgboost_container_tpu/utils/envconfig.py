@@ -1,0 +1,20 @@
+"""Defensive env-var parsing for operational knobs.
+
+Serving/config knobs are tuning levers, not correctness inputs: a typo in
+one (``GRAFT_HOST_PREDICT_ROWS=off``) must degrade to the default, never
+turn into a per-request exception and a serving outage.
+"""
+
+import os
+
+
+def env_int(name, default):
+    """int(os.environ[name]) with fallback to ``default`` on absent,
+    empty, or malformed values."""
+    raw = os.getenv(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default

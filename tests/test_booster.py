@@ -326,3 +326,15 @@ def test_pred_leaf():
     # rows with equal features share leaves
     leaves2 = forest.predict(np.vstack([X[0], X[0]]), pred_leaf=True)
     assert (leaves2[0] == leaves2[1]).all()
+
+
+def test_tree_method_binning_map():
+    """tree_method mapping: exact -> 1024-bin hist (closest static-shape
+    approximation of exact greedy, MIGRATION.md); approx -> bins ~ 1/sketch_eps;
+    explicit max_bin always wins."""
+    from sagemaker_xgboost_container_tpu.models.booster import TrainConfig
+
+    assert TrainConfig({"tree_method": "exact"}).max_bin == 1024
+    assert TrainConfig({"tree_method": "exact", "max_bin": 64}).max_bin == 64
+    assert TrainConfig({"tree_method": "approx", "sketch_eps": 0.01}).max_bin == 100
+    assert TrainConfig({}).max_bin == 256

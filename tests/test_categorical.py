@@ -54,6 +54,9 @@ CASES = [
     ((np.nan, 0.2), -1.0),  # missing -> default_left=True -> left subtree
     ((-1.0, 0.9), 1.0),   # negative category invalid -> default left
     ((40.0, 0.2), -1.0),  # beyond bitmask range invalid -> default left
+    ((3e9, 0.2), -1.0),   # >= 2^31: float->int32 wraps (numpy) / saturates
+                          # (XLA:TPU); float-side range check must go left
+    ((np.inf, 0.9), 1.0),  # +inf invalid -> left subtree
 ]
 
 

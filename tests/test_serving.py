@@ -8,6 +8,7 @@ the MME lifecycle from test/integration/local/test_multiple_model_endpoint.py
 import json
 import os
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -36,6 +37,14 @@ def abalone_model_dir(tmp_path_factory):
     model_dir = tmp_path_factory.mktemp("model")
     forest.save_model(str(model_dir / "xgboost-model"))
     return str(model_dir)
+
+
+def _swallow(batcher, x):
+    """Issue a batcher request, ignoring any error (queue-full test filler)."""
+    try:
+        batcher.predict(x, timeout=10)
+    except Exception:
+        pass
 
 
 def _serve(app):
@@ -324,6 +333,81 @@ class TestMultiModelEndpoint:
             assert status == 404
         finally:
             httpd.shutdown()
+
+    def test_payload_cap_and_hard_limit(self, abalone_model_dir, monkeypatch):
+        """MMS payload sizing contract (reference serving_mms.py:80-83):
+        SAGEMAKER_MAX_REQUEST_SIZE is honored but hard-capped at 20MB."""
+        from sagemaker_xgboost_container_tpu.serving import mme as mme_mod
+
+        monkeypatch.setenv("SAGEMAKER_MAX_REQUEST_SIZE", "1024")
+        assert mme_mod._max_request_size() == 1024
+        monkeypatch.setenv("SAGEMAKER_MAX_REQUEST_SIZE", str(64 * 1024**2))
+        assert mme_mod._max_request_size() == 20 * 1024**2
+        monkeypatch.delenv("SAGEMAKER_MAX_REQUEST_SIZE")
+        monkeypatch.setenv("MAX_CONTENT_LENGTH", "2048")
+        assert mme_mod._max_request_size() == 2048
+
+        monkeypatch.setenv("SAGEMAKER_MAX_REQUEST_SIZE", "64")
+        app = make_mme_app()
+        base, httpd = _serve(app)
+        try:
+            payload = json.dumps(
+                {"model_name": "abalone", "url": abalone_model_dir}
+            ).encode()
+            status, _, _ = _request(
+                base + "/models",
+                method="POST",
+                data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            assert status == 200
+            big = b"1:0.1 " * 50  # > 64 bytes
+            status, _, _ = _request(
+                base + "/models/abalone/invoke",
+                method="POST",
+                data=b"0 " + big,
+                headers={"Content-Type": "text/libsvm"},
+            )
+            assert status == 413
+            status, _, _ = _request(
+                base + "/models/abalone/invoke",
+                method="POST",
+                data=LIBSVM_PAYLOAD,
+                headers={"Content-Type": "text/libsvm"},
+            )
+            assert status == 200
+        finally:
+            httpd.shutdown()
+
+    def test_job_queue_full_returns_503(self):
+        """SAGEMAKER_MODEL_JOB_QUEUE_SIZE analog: a saturated coalescer
+        queue rejects with 503 instead of queueing unboundedly."""
+        from sagemaker_xgboost_container_tpu.serving.batcher import (
+            JobQueueFull,
+            PredictBatcher,
+        )
+
+        release = threading.Event()
+
+        def slow_predict(feats):
+            release.wait(5)
+            return np.zeros(feats.shape[0], np.float32)
+
+        batcher = PredictBatcher(slow_predict, max_queue=1, max_wait_ms=0.1)
+        x = np.zeros((1, 3), np.float32)
+        t = threading.Thread(target=lambda: batcher.predict(x, timeout=10))
+        t.start()
+        time.sleep(0.3)  # worker now blocked inside slow_predict
+        filler = threading.Thread(target=lambda: _swallow(batcher, x))
+        filler.start()
+        time.sleep(0.3)  # one more request pending in the queue -> full
+        try:
+            with pytest.raises(JobQueueFull):
+                batcher.predict(x, timeout=10)
+        finally:
+            release.set()
+            t.join()
+            filler.join()
 
 
 class TestScriptModeServing:

@@ -92,17 +92,19 @@ class TrainConfig:
         self.max_delta_step = float(p.get("max_delta_step", 0.0))
         if p.get("max_bin") is not None:
             self.max_bin = int(p["max_bin"])
-        elif p.get("sketch_eps"):
-            # approx-method users control sketch granularity via sketch_eps;
-            # bins ~ 1/eps is xgboost's own guidance for the hist equivalent
-            self.max_bin = int(min(max(1.0 / float(p["sketch_eps"]), 2), 1024))
         elif p.get("tree_method") == "exact":
             # the reference's exact greedy enumerates every unique value
             # (libxgboost updater; schema hyperparameter_validation.py:22-24).
             # Enumeration is shape-dynamic — hostile to XLA — so exact maps to
             # the hist engine at 4x default sketch resolution, the closest
-            # static-shape approximation; documented in MIGRATION.md
+            # static-shape approximation; documented in MIGRATION.md. Checked
+            # before sketch_eps: that knob is approx-only and a stale value
+            # must not degrade exact to a handful of bins.
             self.max_bin = 1024
+        elif p.get("sketch_eps"):
+            # approx-method users control sketch granularity via sketch_eps;
+            # bins ~ 1/eps is xgboost's own guidance for the hist equivalent
+            self.max_bin = int(min(max(1.0 / float(p["sketch_eps"]), 2), 1024))
         else:
             self.max_bin = 256
         self.subsample = float(p.get("subsample", 1.0))

@@ -184,7 +184,9 @@ def build_tree_lossguide(
 
         # route rows of l
         in_l = node_of_row == l
-        row_bin = jnp.take_along_axis(bins, f_l[None].repeat(n)[:, None], axis=1)[:, 0]
+        # one scalar feature for every row: a dynamic column slice, not a
+        # per-row gather
+        row_bin = jax.lax.dynamic_slice(bins, (0, f_l), (n, 1))[:, 0]
         is_missing = row_bin == (num_bins - 1)
         go_right = jnp.where(is_missing, ~dl_l, row_bin > b_l)
         new_node = jnp.where(go_right, id_b, id_a)

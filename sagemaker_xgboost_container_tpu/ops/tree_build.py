@@ -13,6 +13,8 @@ finalized rows hold -1 and accumulate their leaf value into ``row_out``, so
 the booster updates margins without re-predicting the train set.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -20,6 +22,25 @@ from .histogram import level_histogram, node_totals, subtraction_enabled
 from .split import find_best_splits, leaf_weight
 
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
+
+
+def row_bin_lookup(bins, feat_idx):
+    """Per-row bin of a per-row feature: ``bins[i, feat_idx[i]]``.
+
+    Two lowerings, A/B-able on hardware via ``GRAFT_ROUTE_IMPL``:
+
+    * ``gather`` (default): ``take_along_axis`` — a [n] gather over the lane
+      dimension.
+    * ``onehot``: masked sum over the feature axis — n*d VPU multiply-adds,
+      no gather; can win on TPU where cross-lane gathers serialize.
+
+    Both used by level routing here and binned eval prediction.
+    """
+    if os.environ.get("GRAFT_ROUTE_IMPL", "gather") == "onehot":
+        d = bins.shape[1]
+        oh = feat_idx[:, None] == jnp.arange(d, dtype=jnp.int32)[None, :]
+        return jnp.sum(jnp.where(oh, bins, 0), axis=1)
+    return jnp.take_along_axis(bins, feat_idx[:, None], axis=1)[:, 0]
 
 
 def max_nodes_for_depth(max_depth):
@@ -281,7 +302,7 @@ def build_tree(
         split_feat = splits["feature"][local_safe]
         split_bin = splits["bin"][local_safe]
         if feature_axis_name is None:
-            row_bin = jnp.take_along_axis(bins, split_feat[:, None], axis=1)[:, 0]
+            row_bin = row_bin_lookup(bins, split_feat)
             is_missing = row_bin == (num_bins - 1)
             go_right = jnp.where(
                 is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
@@ -291,7 +312,7 @@ def build_tree(
             # rows; decisions psum-broadcast along the feature axis
             owner = (split_feat // d) == feat_shard
             local_idx = jnp.clip(split_feat - feat_shard * d, 0, d - 1)
-            row_bin = jnp.take_along_axis(bins, local_idx[:, None], axis=1)[:, 0]
+            row_bin = row_bin_lookup(bins, local_idx)
             is_missing = row_bin == (num_bins - 1)
             decision = jnp.where(
                 is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
@@ -394,7 +415,7 @@ def predict_binned(tree, bins, max_depth, num_bins):
         i, node = state
         feat = tree["feature"][node]
         split_bin = tree["bin"][node]
-        row_bin = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
+        row_bin = row_bin_lookup(bins, feat)
         is_missing = row_bin == (num_bins - 1)
         go_right = jnp.where(is_missing, ~tree["default_left"][node], row_bin > split_bin)
         child = jnp.where(go_right, tree["right"][node], tree["left"][node])

@@ -50,10 +50,13 @@ class PredictBatcher:
         self.predict_fn = predict_fn
         self.max_batch_rows = max_batch_rows
         self.max_wait_ms = max_wait_ms
-        self.max_queue = max_queue
         # bounded queue -> the limit is atomic (put_nowait raises Full);
-        # a qsize() check-then-put would race under concurrent WSGI threads
-        self._queue = queue.Queue(maxsize=max_queue or 0)
+        # a qsize() check-then-put would race under concurrent WSGI threads.
+        # Clamped to >=1 when bounded: Queue(maxsize=0) means UNLIMITED in
+        # Python, which would invert a SAGEMAKER_MODEL_JOB_QUEUE_SIZE=0 knob
+        # into the unbounded queueing it exists to prevent.
+        self.max_queue = None if max_queue is None else max(1, max_queue)
+        self._queue = queue.Queue(maxsize=self.max_queue or 0)
         self._carry = None  # width-mismatched request deferred to next batch
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()

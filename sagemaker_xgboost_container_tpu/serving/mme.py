@@ -39,7 +39,7 @@ from . import serve_utils
 from ..toolkit import exceptions as exc
 from ..utils.envconfig import env_int
 from .app import _read_body, _response, parse_accept
-from .batcher import JobQueueFull
+from .batcher import JobQueueFull, PredictBatcher
 
 logger = logging.getLogger(__name__)
 
@@ -74,8 +74,6 @@ class ModelManager:
         )
         batcher = None
         if not isinstance(model, list):
-            from .batcher import PredictBatcher
-
             rng = serve_utils.best_iteration_range(model)
             batcher = PredictBatcher(
                 lambda feats, _m=model, _r=rng: _m.predict(feats, iteration_range=_r),

@@ -338,3 +338,11 @@ def test_tree_method_binning_map():
     assert TrainConfig({"tree_method": "exact", "max_bin": 64}).max_bin == 64
     assert TrainConfig({"tree_method": "approx", "sketch_eps": 0.01}).max_bin == 100
     assert TrainConfig({}).max_bin == 256
+
+
+def test_exact_wins_over_stale_sketch_eps():
+    """A leftover approx-only sketch_eps must not degrade tree_method=exact
+    to a handful of bins."""
+    from sagemaker_xgboost_container_tpu.models.booster import TrainConfig
+
+    assert TrainConfig({"tree_method": "exact", "sketch_eps": 0.3}).max_bin == 1024

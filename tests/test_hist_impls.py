@@ -240,3 +240,36 @@ def test_colsample_bynode_actually_wired():
     assert full_feats.shape != narrow_feats.shape or not np.array_equal(
         full_feats, narrow_feats
     ), "colsample_bynode had no effect on tree structure"
+
+
+def test_route_impls_equivalent():
+    """GRAFT_ROUTE_IMPL=onehot must build identical trees to the gather
+    default (both levelwise routing and binned eval prediction use it)."""
+    import os
+
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(5)
+    X = rng.rand(3000, 7).astype(np.float32)
+    X[rng.rand(3000, 7) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 2]) > 1).astype(np.float32)
+    d = DataMatrix(X, labels=y)
+    params = {"objective": "binary:logistic", "max_depth": 5}
+
+    prior = os.environ.get("GRAFT_ROUTE_IMPL")
+    try:
+        os.environ["GRAFT_ROUTE_IMPL"] = "gather"
+        f_gather = train(params, d, num_boost_round=4)
+        os.environ["GRAFT_ROUTE_IMPL"] = "onehot"
+        f_onehot = train(params, d, num_boost_round=4)
+    finally:
+        if prior is None:
+            os.environ.pop("GRAFT_ROUTE_IMPL", None)
+        else:
+            os.environ["GRAFT_ROUTE_IMPL"] = prior
+    np.testing.assert_array_equal(
+        np.asarray(f_gather.predict_margin(X)), np.asarray(f_onehot.predict_margin(X))
+    )

@@ -63,10 +63,12 @@ def _supervised_main():
     else:
         # impl x operand-precision matrix (quality-validated: bf16 one-hot
         # matmul matches f32 val-logloss/auc on the bench task, BASELINE.md)
+        # precision pinned in every entry: an inherited GRAFT_HIST_MM_PREC
+        # would otherwise silently collapse the A/B
         configs = [
-            ("flat", {"GRAFT_HIST_IMPL": "flat"}),
-            ("matmul", {"GRAFT_HIST_IMPL": "matmul"}),
-            ("pallas", {"GRAFT_HIST_IMPL": "pallas"}),
+            ("flat", {"GRAFT_HIST_IMPL": "flat", "GRAFT_HIST_MM_PREC": "bf16x2"}),
+            ("matmul", {"GRAFT_HIST_IMPL": "matmul", "GRAFT_HIST_MM_PREC": "bf16x2"}),
+            ("pallas", {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16x2"}),
             (
                 "pallas,prec=bf16",
                 {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16"},

@@ -217,6 +217,19 @@ class Forest:
 
     # ------------------------------------------------------------ prediction
     def _stack(self, tree_slice):
+        # memoized per (start, stop): serving calls predict per request and a
+        # rebuild of the padded [T, N] arrays (a Python loop over every tree)
+        # costs ~5ms on a 100-tree forest — dominating small-payload latency
+        key = (tree_slice.start, tree_slice.stop)
+        if self._stacked_cache is None:
+            self._stacked_cache = {}
+        if key in self._stacked_cache:
+            return self._stacked_cache[key]
+        stacked = self._stack_uncached(tree_slice)
+        self._stacked_cache[key] = stacked
+        return stacked
+
+    def _stack_uncached(self, tree_slice):
         trees = self.trees[tree_slice]
         if not trees:
             return None

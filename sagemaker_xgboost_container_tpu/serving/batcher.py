@@ -15,6 +15,7 @@ the predict path's power-of-two bucketing keeps the jit cache small.
 import logging
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -76,13 +77,28 @@ class PredictBatcher:
         return pending.result
 
     # ------------------------------------------------------------------ int
-    def _drain_batch(self, first):
+    def _drain_batch(self, first, wait):
+        """Collect a batch starting from ``first``.
+
+        ``wait``: whether to linger max_wait_ms for stragglers. A lone
+        request on an idle endpoint must NOT pay the linger (it would add
+        max_wait_ms to every p50); under concurrency the queue accumulates
+        while predict_fn runs, so coalescing happens even with wait=False.
+        The worker passes wait=True only after a batch that actually
+        coalesced — evidence of concurrent load.
+        """
         batch = [first]
         rows = first.features.shape[0]
-        deadline_wait = self.max_wait_ms / 1000.0
+        # ONE deadline for the whole batch: re-arming the timeout per
+        # straggler would let a trickle of arrivals defer dispatch unboundedly
+        deadline = time.monotonic() + (self.max_wait_ms / 1000.0 if wait else 0.0)
         while rows < self.max_batch_rows:
             try:
-                nxt = self._queue.get(timeout=deadline_wait)
+                remaining = deadline - time.monotonic()
+                if remaining > 0:
+                    nxt = self._queue.get(timeout=remaining)
+                else:
+                    nxt = self._queue.get_nowait()
             except queue.Empty:
                 break
             if nxt.features.shape[1] != first.features.shape[1]:
@@ -95,12 +111,14 @@ class PredictBatcher:
         return batch
 
     def _worker(self):
+        loaded = False  # previous batch coalesced -> linger for stragglers
         while True:
             if self._carry is not None:
                 first, self._carry = self._carry, None
             else:
                 first = self._queue.get()
-            batch = self._drain_batch(first)
+            batch = self._drain_batch(first, wait=loaded)
+            loaded = len(batch) > 1
             try:
                 stacked = (
                     batch[0].features

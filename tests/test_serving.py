@@ -587,3 +587,52 @@ def test_ensemble_vote_for_softmax(tmp_path):
     preds = np.asarray(preds)
     assert preds.shape == (20,)
     assert set(np.unique(preds)).issubset({0.0, 1.0, 2.0})
+
+
+class TestConcurrentServing:
+    def test_parallel_clients_all_correct(self, tmp_path):
+        """32 concurrent clients x 3 rounds: no connection resets (listen
+        backlog), every response correct (coalescer scatter-back)."""
+        rng = np.random.RandomState(0)
+        X = rng.rand(500, 6).astype(np.float32)
+        y = (X @ rng.rand(6).astype(np.float32) * 5).astype(np.float32)
+        forest = train(
+            {"max_depth": 4, "objective": "reg:squarederror"},
+            DataMatrix(X, labels=y),
+            num_boost_round=10,
+        )
+        forest.save_model(os.path.join(str(tmp_path), "xgboost-model"))
+        expect = np.asarray(forest.predict(X[:32]))
+
+        app = make_app(ScoringService(str(tmp_path)))
+        base, httpd = _serve(app)
+        errors = []
+
+        def hit(i, out):
+            try:
+                body = ",".join("%.6f" % v for v in X[i]).encode()
+                status, resp, _ = _request(
+                    base + "/invocations",
+                    method="POST",
+                    data=body,
+                    headers={"Content-Type": "text/csv"},
+                )
+                assert status == 200
+                out[i] = float(resp.decode().strip())
+            except Exception as e:  # surface in the main thread
+                errors.append((i, repr(e)))
+
+        try:
+            for _ in range(3):
+                out = [None] * 32
+                ts = [
+                    threading.Thread(target=hit, args=(i, out)) for i in range(32)
+                ]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                assert not errors, errors[:3]
+                np.testing.assert_allclose(out, expect, rtol=1e-4)
+        finally:
+            httpd.shutdown()

@@ -1078,6 +1078,7 @@ def train(
             feval=feval,
             callbacks=callbacks,
             initial_model=initial,
+            mesh=mesh,
         )
 
     if xgb_model is None:
@@ -1105,7 +1106,8 @@ def train(
         from .dart import train_dart
 
         return train_dart(
-            config, forest, dtrain, list(evals), feval, callbacks, num_boost_round
+            config, forest, dtrain, list(evals), feval, callbacks, num_boost_round,
+            mesh=mesh,
         )
 
     metric_names = _eval_metric_names(config, forest.objective())

@@ -30,9 +30,17 @@ from .forest import compact_padded_tree
 logger = logging.getLogger(__name__)
 
 
-def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round):
+def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round, mesh=None):
     if config.num_class > 1:
         raise exc.UserError("booster=dart with multi-class objectives is not supported yet.")
+    if mesh is not None and jax.process_count() > 1:
+        raise exc.UserError(
+            "booster=dart does not support multi-process distributed training "
+            "yet; run single-host (multi-device meshes within one host are "
+            "supported)."
+        )
+    if mesh is not None and "data" not in getattr(mesh, "axis_names", ()):
+        mesh = None
     p = config.objective_params
     rate_drop = float(p.get("rate_drop", 0.0))
     skip_drop = float(p.get("skip_drop", 0.0))
@@ -49,7 +57,11 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round)
                 "iteration does not reproduce the best model."
             )
 
-    session = _TrainingSession(config, dtrain, list(evals), forest)
+    # With a mesh the session shards rows over the data axis; dart's own
+    # jitted builder/grad ops run on those sharded arrays under XLA's
+    # automatic SPMD partitioning (GSPMD inserts the histogram combines —
+    # semantically the same global program, so trees match single-device)
+    session = _TrainingSession(config, dtrain, list(evals), forest, mesh=mesh)
     metric_names = _eval_metric_names(config, session.objective)
 
     # build trees with unit shrinkage; dart applies its own scaling
@@ -83,6 +95,9 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round)
 
         stacked = forest._stack(slice(0, len(forest.trees)))
         leaf = forest_leaf_margins(stacked, dtrain.features)  # [n, T]
+        n_pad = session.bins.shape[0]
+        if leaf.shape[0] != n_pad:  # mesh padding: align with session rows
+            leaf = jnp.pad(leaf, ((0, n_pad - leaf.shape[0]), (0, 0)))
         for i in range(len(forest.trees)):
             tree_contribs.append(leaf[:, i])
             tree_weights.append(1.0)

@@ -134,11 +134,17 @@ class LinearModel:
 
 
 def train_linear(
-    config, dtrain, num_boost_round, evals=(), feval=None, callbacks=None, initial_model=None
+    config, dtrain, num_boost_round, evals=(), feval=None, callbacks=None,
+    initial_model=None, mesh=None,
 ):
     """Train a gblinear model; mirrors booster.train's loop contract.
 
-    initial_model: a LinearModel to continue from (checkpoint resume)."""
+    initial_model: a LinearModel to continue from (checkpoint resume).
+    mesh: optional Mesh with a "data" axis — rows shard across devices and
+    the per-coordinate sufficient statistics (x_j·g, x_j²·h, bias sums)
+    psum across the axis, so every device runs identical weight updates
+    (the reference trains gblinear under Rabit the same way: allreduced
+    gradient sums in libxgboost's linear updater)."""
     from . import eval_metrics
     from .booster import _eval_metric_names
 
@@ -149,12 +155,68 @@ def train_linear(
 
     n, d = dtrain.num_row, dtrain.num_col
     x_host = np.nan_to_num(dtrain.features, nan=0.0)  # linear path: missing = 0
-    x = jnp.asarray(x_host)
-    xT = jnp.asarray(np.ascontiguousarray(x_host.T))
-    xT_sq = xT**2
-    del x_host
-    labels = jnp.asarray(dtrain.labels)
-    weights_row = jnp.asarray(dtrain.get_weight())
+
+    if mesh is not None:
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # checked before the axis-name test: a multi-process run with any
+            # mesh must refuse loudly, never fall through to per-host models
+            raise exc.UserError(
+                "booster=gblinear does not support multi-process distributed "
+                "training yet; run single-host (multi-device meshes within "
+                "one host are supported)."
+            )
+
+    n_shards = 1
+    axis = None
+    if mesh is not None and "data" in getattr(mesh, "axis_names", ()):
+        n_shards = int(mesh.shape["data"])
+        if n_shards > 1:
+            axis = "data"
+    if axis is not None and config.objective == "survival:cox":
+        # Cox risk sets span the whole dataset; inside shard_map grad_hess
+        # would see only shard-local rows and silently compute wrong risk
+        # sets (the tree path has a dedicated global-cumsum cox — this
+        # linear path does not yet)
+        raise exc.UserError(
+            "booster=gblinear with objective=survival:cox does not support "
+            "mesh training yet; run single-device."
+        )
+
+    from .booster import _pad_rows
+
+    n_pad = -(-n // n_shards) * n_shards
+    if n_pad != n:
+        # zero-weight padding rows: contribute nothing to any psum'd stat
+        x_host = _pad_rows(x_host, n_pad, 0.0)
+    xT_host = np.ascontiguousarray(x_host.T)
+    labels_np = _pad_rows(np.asarray(dtrain.labels, np.float32), n_pad, 0.0)
+    weights_np = _pad_rows(np.asarray(dtrain.get_weight(), np.float32), n_pad, 0.0)
+    if axis is not None:
+        # place each array in its shard_map layout ONCE; jnp.asarray would
+        # commit them to the default device and every round's dispatch would
+        # re-scatter ~3x the dataset
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def put(arr, spec):
+            return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+        x = put(x_host, P("data", None))
+        xT = put(xT_host, P(None, "data"))
+        xT_sq = put(xT_host**2, P(None, "data"))
+        lab_spec = P("data") if labels_np.ndim == 1 else P("data", None)
+        labels = put(labels_np, lab_spec)
+        weights_row = put(weights_np, P("data"))
+    else:
+        x = jnp.asarray(x_host)
+        xT = jnp.asarray(xT_host)
+        xT_sq = xT**2
+        labels = jnp.asarray(labels_np)
+        weights_row = jnp.asarray(weights_np)
+    del x_host, xT_host
+    n = n_pad
     base = objective.base_margin(config.base_score)
 
     lambda_ = config.reg_lambda
@@ -171,38 +233,74 @@ def train_linear(
         b = jnp.zeros(G, jnp.float32)
         start_round = 0
 
-    def margin_of(wc, bc):
-        m = x @ wc + bc[None, :] + base
-        return m[:, 0] if G == 1 else m
-
-    @jax.jit
-    def one_round(wc, bc):
+    def _round_body(x_s, xT_s, xT_sq_s, labels_s, weights_s, wc, bc):
         """Sequential coordinate descent (xgboost's coord_descent updater):
         grad/hess computed once per round, then per-coordinate updates with
         the per-row gradient adjusted incrementally (g += h * x_j * delta) —
         stable under correlated features where simultaneous shotgun updates
         diverge. The coordinate sweep is a lax.scan over features, fully
-        on-device."""
-        margins = margin_of(wc, bc)
-        g, h = objective.grad_hess(margins, labels, weights_row)
-        g2 = g.reshape(n, G) if G > 1 else g[:, None]
-        h2 = h.reshape(n, G) if G > 1 else h[:, None]
+        on-device. Row-dim inputs may be a data-axis shard: every sum over
+        rows psums so all shards compute identical updates."""
+        n_s = x_s.shape[0]
+        m = x_s @ wc + bc[None, :] + base
+        margins = m[:, 0] if G == 1 else m
+        g, h = objective.grad_hess(margins, labels_s, weights_s)
+        g2 = g.reshape(n_s, G) if G > 1 else g[:, None]
+        h2 = h.reshape(n_s, G) if G > 1 else h[:, None]
+
+        def allsum(v):
+            return jax.lax.psum(v, axis) if axis is not None else v
 
         def step(g_cur, inputs):
-            x_j, x2_j, w_j = inputs          # [n], [n], [G]
-            gw = x_j @ g_cur + lambda_ * w_j            # [G]
-            hw = x2_j @ h2 + lambda_                    # [G]
+            x_j, x2_j, w_j = inputs          # [n_s], [n_s], [G]
+            gw = allsum(x_j @ g_cur) + lambda_ * w_j    # [G]
+            hw = allsum(x2_j @ h2) + lambda_            # [G]
             raw = w_j - gw / hw
             new_w = jnp.sign(raw) * jnp.maximum(jnp.abs(raw) - alpha / hw, 0.0)
             delta = eta * (new_w - w_j)
             g_cur = g_cur + h2 * x_j[:, None] * delta[None, :]
             return g_cur, w_j + delta
 
-        g2, new_w = jax.lax.scan(step, g2, (xT, xT_sq, wc))
-        gb = g2.sum(axis=0) + lambda_bias * bc
-        hb = h2.sum(axis=0) + lambda_bias
+        g2, new_w = jax.lax.scan(step, g2, (xT_s, xT_sq_s, wc))
+        gb = allsum(g2.sum(axis=0)) + lambda_bias * bc
+        hb = allsum(h2.sum(axis=0)) + lambda_bias
         bc = bc - eta * gb / jnp.maximum(hb, 1e-6)
         return new_w, bc
+
+    if axis is not None:
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+
+            rep_kw = {"check_vma": False}
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+            rep_kw = {"check_rep": False}  # pre-0.6 kwarg name
+
+        lab_spec = P("data") if labels.ndim == 1 else P("data", None)
+        one_round_sharded = jax.jit(
+            shard_map(
+                _round_body,
+                mesh=mesh,
+                in_specs=(
+                    P("data", None), P(None, "data"), P(None, "data"),
+                    lab_spec, P("data"), P(None, None), P(None),
+                ),
+                out_specs=(P(None, None), P(None)),
+                **rep_kw,
+            )
+        )
+
+        def one_round(wc, bc):
+            return one_round_sharded(x, xT, xT_sq, labels, weights_row, wc, bc)
+
+    else:
+
+        @jax.jit
+        def one_round(wc, bc):
+            return _round_body(x, xT, xT_sq, labels, weights_row, wc, bc)
 
     model = LinearModel(
         np.zeros((d, G)), np.zeros(G),

@@ -728,3 +728,44 @@ def test_survival_cox_on_mesh_matches_single_device(mesh8):
     m = sharded.predict(X, output_margin=True)
     corr = np.corrcoef(m, np.log(hazard))[0, 1]
     assert corr > 0.6, corr
+
+
+@pytest.mark.multichip
+def test_gblinear_mesh_matches_single_device(mesh8):
+    """gblinear on a data mesh: coordinate-descent sufficient statistics
+    psum across shards, so weights match single-device (the reference
+    trains gblinear under Rabit with allreduced gradient sums)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(1003, 6).astype(np.float32)  # not divisible by 8
+    y = (X @ rng.randn(6).astype(np.float32) + 0.1 * rng.randn(1003)).astype(
+        np.float32
+    )
+    params = {
+        "booster": "gblinear", "objective": "reg:squarederror",
+        "eta": 0.5, "lambda": 1.0, "alpha": 0.1,
+    }
+    single = train(params, DataMatrix(X, labels=y), num_boost_round=12)
+    dist = train(params, DataMatrix(X, labels=y), num_boost_round=12, mesh=mesh8)
+    np.testing.assert_allclose(single.weights, dist.weights, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(single.bias, dist.bias, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.multichip
+def test_dart_mesh_matches_single_device(mesh8):
+    """dart on a data mesh: the session shards rows; GSPMD partitions the
+    dart builder's histogram ops, so dropout/rescale bookkeeping and trees
+    match single-device."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(2005, 6).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.8).astype(np.float32)
+    params = {
+        "booster": "dart", "objective": "binary:logistic", "max_depth": 4,
+        "rate_drop": 0.3, "one_drop": 1, "seed": 7,
+    }
+    single = train(params, DataMatrix(X, labels=y), num_boost_round=8)
+    dist = train(params, DataMatrix(X, labels=y), num_boost_round=8, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(single.predict(X[:200])),
+        np.asarray(dist.predict(X[:200])),
+        rtol=1e-4, atol=1e-5,
+    )

@@ -145,11 +145,12 @@ class TrainConfig:
                 "colsample_bylevel is not supported with grow_policy='lossguide' yet; "
                 "use colsample_bytree."
             )
-        if p.get("process_type") == "update":
+        self.process_type = p.get("process_type", "default")
+        if self.process_type not in ("default", "update"):
             raise exc.UserError(
-                "process_type='update' (refresh/prune of an existing model) is not "
-                "supported yet in the TPU container; retrain with process_type="
-                "'default' instead."
+                "process_type must be 'default' or 'update', got {!r}".format(
+                    self.process_type
+                )
             )
 
 
@@ -1055,6 +1056,14 @@ def train(
     config = TrainConfig(params)
     callbacks = list(callbacks or [])
 
+    if config.process_type == "update" and config.booster == "gblinear":
+        # checked before the gblinear branch returns: otherwise a refresh
+        # request is silently reinterpreted as "boost more rounds"
+        raise exc.UserError(
+            "process_type 'update' can only be used with updater 'refresh' and "
+            "'prune' (tree boosters); booster=gblinear does not support it."
+        )
+
     if config.booster == "gblinear":
         from .gblinear import LinearModel, train_linear
 
@@ -1101,6 +1110,13 @@ def train(
     if forest.num_feature < dtrain.num_col and forest.trees:
         raise exc.UserError("feature_names mismatch between checkpoint and data")
     forest.num_feature = max(forest.num_feature, dtrain.num_col)
+
+    if config.process_type == "update":
+        from .update import train_update
+
+        return train_update(
+            config, forest, dtrain, list(evals), feval, callbacks, num_boost_round
+        )
 
     if config.booster == "dart":
         from .dart import train_dart

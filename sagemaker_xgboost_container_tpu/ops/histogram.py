@@ -41,6 +41,18 @@ def _matmul_chunk():
     return int(os.environ.get("GRAFT_HIST_CHUNK", 65536))
 
 
+def _balanced_chunks(n):
+    """(chunk, steps) for scanning n rows in ~GRAFT_HIST_CHUNK-row chunks.
+
+    Balanced: caps padding waste at steps-1 rows instead of a nearly full
+    chunk when n slightly exceeds a multiple of the configured size.
+    Requires n >= 1.
+    """
+    steps_wanted = -(-n // min(_matmul_chunk(), n))
+    chunk = -(-n // steps_wanted)
+    return chunk, -(-n // chunk)
+
+
 def _pallas_block():
     return int(os.environ.get("GRAFT_HIST_BLOCK", 512))
 
@@ -101,19 +113,75 @@ def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
     The last tree level only needs leaf weights -> node totals; skipping the
     [W, d, B] histogram there removes the widest (most expensive) level from
     every tree build.
+
+    Two lowerings via ``GRAFT_TOTALS_IMPL``: ``segment`` (default) uses
+    segment_sum (sorted scatter-add on TPU — sorts all n rows by node id);
+    ``onehot`` scans row chunks and contracts a node one-hot on the MXU,
+    avoiding the sort entirely (same trick as the matmul histograms).
     """
-    active = node_local >= 0
-    safe = jnp.where(active, node_local, num_nodes)
-    g_tot = jax.ops.segment_sum(
-        jnp.where(active, grad, 0.0), safe, num_segments=num_nodes + 1
-    )[:num_nodes]
-    h_tot = jax.ops.segment_sum(
-        jnp.where(active, hess, 0.0), safe, num_segments=num_nodes + 1
-    )[:num_nodes]
+    impl = os.environ.get("GRAFT_TOTALS_IMPL", "segment")
+    if impl == "onehot":
+        g_tot, h_tot = _totals_onehot(grad, hess, node_local, num_nodes)
+    elif impl != "segment":
+        raise ValueError(
+            "Unknown GRAFT_TOTALS_IMPL=%r; expected segment|onehot" % impl
+        )
+    else:
+        active = node_local >= 0
+        safe = jnp.where(active, node_local, num_nodes)
+        g_tot = jax.ops.segment_sum(
+            jnp.where(active, grad, 0.0), safe, num_segments=num_nodes + 1
+        )[:num_nodes]
+        h_tot = jax.ops.segment_sum(
+            jnp.where(active, hess, 0.0), safe, num_segments=num_nodes + 1
+        )[:num_nodes]
     if axis_name is not None:
         g_tot = jax.lax.psum(g_tot, axis_name)
         h_tot = jax.lax.psum(h_tot, axis_name)
     return g_tot, h_tot
+
+
+def _totals_onehot(grad, hess, node_local, num_nodes):
+    """[2, c] @ node-one-hot[c, W] per row chunk, f32 accumulated — no sort,
+    no scatter; the one-hot never leaves registers/VMEM after fusion."""
+    n = grad.shape[0]
+    W = num_nodes
+    if n == 0:
+        z = jnp.zeros(W, jnp.float32)
+        return z, z
+    active = node_local >= 0
+    g = jnp.where(active, grad, 0.0)
+    h = jnp.where(active, hess, 0.0)
+    node = jnp.where(active, node_local, W)  # dead slot -> one-hot 0
+
+    chunk, steps = _balanced_chunks(n)
+    n_pad = steps * chunk
+    if n_pad != n:
+        pad = [(0, n_pad - n)]
+        g = jnp.pad(g, pad)
+        h = jnp.pad(h, pad)
+        node = jnp.pad(node, pad, constant_values=W)
+
+    iota_w = jnp.arange(W, dtype=jnp.int32)
+
+    def body(carry, i):
+        sl = i * chunk
+        node_c = jax.lax.dynamic_slice(node, (sl,), (chunk,))
+        g_c = jax.lax.dynamic_slice(g, (sl,), (chunk,))
+        h_c = jax.lax.dynamic_slice(h, (sl,), (chunk,))
+        oh = (node_c[:, None] == iota_w[None, :]).astype(jnp.float32)  # [c, W]
+        gh = jnp.stack([g_c, h_c])  # [2, c]
+        P = jax.lax.dot_general(
+            gh, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return carry + P, None
+
+    init = jnp.zeros((2, W), jnp.float32)
+    if steps == 1:
+        GH, _ = body(init, jnp.int32(0))
+    else:
+        GH, _ = jax.lax.scan(body, init, jnp.arange(steps, dtype=jnp.int32))
+    return GH[0], GH[1]
 
 
 # --------------------------------------------------------------------- flat
@@ -170,6 +238,40 @@ def _split_bf16(x):
     return hi, lo
 
 
+def _mxu_split_missing(B):
+    """When B = k*128 + 1 (the usual max_bin=256 -> 257 with the missing bin
+    last), the one-hot dot's N dimension pads to the next lane multiple
+    (257 -> 384 on the MXU, +50% wasted FLOPs). Splitting the missing column
+    out — one [2W, d] dot over the (bins == B-1) mask — keeps the per-feature
+    dots at an exact lane multiple. GRAFT_HIST_ALIGN=0 disables."""
+    if os.environ.get("GRAFT_HIST_ALIGN", "1") != "1":
+        return False
+    return B > 128 and (B - 1) % 128 == 0
+
+
+def _dot_prec(A, Ob32, prec):
+    """dot_general(A^T, Ob) with GRAFT_HIST_MM_PREC operand handling,
+    f32 accumulation. A [c, M] f32; Ob32 [c, N] f32 -> [M, N] f32."""
+    if prec == "f32":
+        return jax.lax.dot_general(
+            A, Ob32, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    if prec == "bf16":
+        return jax.lax.dot_general(
+            A.astype(jnp.bfloat16),
+            Ob32.astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    Ob = Ob32.astype(jnp.bfloat16)
+    hi, lo = _split_bf16(A)
+    return jax.lax.dot_general(
+        hi, Ob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        lo, Ob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
 def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
     """One-hot matmul histogram, scanned over row chunks.
 
@@ -181,27 +283,28 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
     W = num_nodes
     B = num_bins
     prec = _matmul_precision()
+    if n == 0:
+        z = jnp.zeros((W, d, B), jnp.float32)
+        return z, z
 
     active = node_local >= 0
     g = jnp.where(active, grad, 0.0)
     h = jnp.where(active, hess, 0.0)
     node = jnp.where(active, node_local, W)  # W = dead slot, one-hot -> 0
 
-    # balanced chunks: cap padding waste at steps-1 rows instead of a nearly
-    # full chunk when n slightly exceeds a multiple of the configured size
-    steps_wanted = -(-n // min(_matmul_chunk(), max(n, 1)))
-    chunk = -(-n // steps_wanted)
-    n_pad = -(-n // chunk) * chunk
+    chunk, steps = _balanced_chunks(n)
+    n_pad = steps * chunk
     if n_pad != n:
         pad = [(0, n_pad - n)]
         g = jnp.pad(g, pad)
         h = jnp.pad(h, pad)
         node = jnp.pad(node, pad, constant_values=W)
         bins = jnp.pad(bins, pad + [(0, 0)])
-    steps = n_pad // chunk
 
+    split_missing = _mxu_split_missing(B)
+    Bm = B - 1 if split_missing else B
     iota_w = jnp.arange(W, dtype=jnp.int32)
-    iota_b = jnp.arange(B, dtype=jnp.int32)
+    iota_b = jnp.arange(Bm, dtype=jnp.int32)
 
     def body(carry, i):
         GH = carry
@@ -217,29 +320,13 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         per_f = []
         for f in range(d):
             Ob32 = (bins_c[:, f][:, None] == iota_b[None, :]).astype(jnp.float32)
-            if prec == "f32":
-                P = jax.lax.dot_general(
-                    A, Ob32, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            elif prec == "bf16":
-                P = jax.lax.dot_general(
-                    A.astype(jnp.bfloat16), Ob32.astype(jnp.bfloat16),
-                    (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            else:  # bf16x2
-                Ob = Ob32.astype(jnp.bfloat16)
-                hi, lo = _split_bf16(A)
-                P = jax.lax.dot_general(
-                    hi, Ob, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ) + jax.lax.dot_general(
-                    lo, Ob, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            per_f.append(P)
-        GH = GH + jnp.stack(per_f, axis=1)  # [2W, d, B]
+            per_f.append(_dot_prec(A, Ob32, prec))
+        delta = jnp.stack(per_f, axis=1)  # [2W, d, Bm]
+        if split_missing:
+            miss = (bins_c == (B - 1)).astype(jnp.float32)  # [c, d]
+            Pm = _dot_prec(A, miss, prec)  # [2W, d]
+            delta = jnp.concatenate([delta, Pm[:, :, None]], axis=2)
+        GH = GH + delta
         return GH, None
 
     init = jnp.zeros((2 * W, d, B), jnp.float32)
@@ -254,9 +341,11 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
 
 
 @functools.lru_cache(maxsize=None)
-def _pallas_hist_fn(n, d, W, B, block, prec, interpret):
+def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
     """Compiled pallas histogram: (bins i32 [n,d], gh f32 [n,2], node i32 [n,1])
-    -> [2W, d, B] f32. Grid over row blocks; VMEM-resident accumulator."""
+    -> [2W, d, B] f32. Grid over row blocks; VMEM-resident accumulator.
+    split_missing: see _mxu_split_missing (part of the cache key because the
+    kernel body changes with it)."""
     import jax.experimental.pallas as pl
 
     try:
@@ -266,6 +355,8 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret):
     except ImportError:  # pragma: no cover
         pltpu = None
         vmem = None
+
+    Bm = B - 1 if split_missing else B
 
     def kernel(bins_ref, gh_ref, node_ref, out_ref):
         step = pl.program_id(0)
@@ -289,7 +380,7 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret):
             A_lo = None
         else:
             A_hi, A_lo = A, None
-        iota_b = jax.lax.broadcasted_iota(jnp.int32, (block, B), 1)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (block, Bm), 1)
         for f in range(d):
             ob = (bins_ref[:, f][:, None] == iota_b)
             ob = ob.astype(A_hi.dtype)
@@ -302,7 +393,19 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret):
                     A_lo, ob.astype(A_lo.dtype), (((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-            out_ref[:, f, :] += P
+            out_ref[:, f, :Bm] += P
+        if split_missing:
+            miss = (bins_ref[:] == (B - 1)).astype(A_hi.dtype)  # [blk, d]
+            Pm = jax.lax.dot_general(
+                A_hi, miss, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if A_lo is not None:
+                Pm = Pm + jax.lax.dot_general(
+                    A_lo, miss.astype(A_lo.dtype), (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            out_ref[:, :, Bm:Bm + 1] += Pm[:, :, None]
 
     steps = n // block
     if vmem is not None and not interpret:
@@ -346,6 +449,8 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
         bins = jnp.pad(bins, pad + [(0, 0)])
 
     gh = jnp.stack([g, h], axis=1)                     # [n, 2]
-    fn = _pallas_hist_fn(n_pad, d, W, B, block, prec, interpret)
+    fn = _pallas_hist_fn(
+        n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B)
+    )
     GH = fn(bins.astype(jnp.int32), gh, node[:, None].astype(jnp.int32))
     return GH[:W], GH[W:]

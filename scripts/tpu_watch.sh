@@ -1,0 +1,31 @@
+#!/bin/bash
+# TPU recovery watcher: probe the tunneled chip every 150s; when it answers,
+# run the per-stage dissection (pallas + route A/B) and the serving bench,
+# then exit so the harness surfaces the results. Artifacts in .tpuwatch/.
+set -u
+cd "$(dirname "$0")/.."
+OUT=.tpuwatch
+mkdir -p "$OUT"
+PROBE='import jax; print(jax.devices()); import jax.numpy as j; print((j.ones((128,128))@j.ones((128,128))).sum())'
+
+echo "[watch] start $(date +%H:%M:%S)" >> "$OUT/watch.log"
+while true; do
+  if timeout 75 python -c "$PROBE" >> "$OUT/watch.log" 2>&1; then
+    echo "[watch] chip healthy $(date +%H:%M:%S)" >> "$OUT/watch.log"
+    break
+  fi
+  echo "[watch] still down $(date +%H:%M:%S)" >> "$OUT/watch.log"
+  sleep 150
+done
+
+run() {  # run <timeout> <logfile> <env...> -- cmd...
+  local t=$1 log=$2; shift 2
+  echo "=== $* ($(date +%H:%M:%S))" >> "$OUT/$log"
+  timeout "$t" env "$@" >> "$OUT/$log" 2>&1
+  echo "=== rc=$? ($(date +%H:%M:%S))" >> "$OUT/$log"
+}
+
+run 1500 dissect_pallas.log GRAFT_HIST_IMPL=pallas python scripts/dissect.py
+run 1200 dissect_onehot.log GRAFT_HIST_IMPL=pallas GRAFT_ROUTE_IMPL=onehot python scripts/dissect.py
+run 900 bench_serve.log python bench_serve.py
+echo "[watch] done $(date +%H:%M:%S)" >> "$OUT/watch.log"

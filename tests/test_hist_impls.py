@@ -273,3 +273,72 @@ def test_route_impls_equivalent():
     np.testing.assert_array_equal(
         np.asarray(f_gather.predict_margin(X)), np.asarray(f_onehot.predict_margin(X))
     )
+
+
+def test_mxu_aligned_hist_matches_flat():
+    """GRAFT_HIST_ALIGN splits the missing-bin column out of the one-hot dot
+    whenever B = k*128 + 1 (max_bin=256 -> B=257 pads to 384 MXU lanes
+    otherwise). Both aligned and unaligned matmul/pallas paths must match
+    the flat scatter reference bin-for-bin, including the missing column."""
+    rng = np.random.RandomState(11)
+    n, d, W, B = 4000, 5, 8, 257
+    bins = jnp.asarray(rng.randint(0, B, size=(n, d)).astype(np.int32))
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray((rng.rand(n) + 0.1).astype(np.float32))
+    node = jnp.asarray(rng.randint(-1, W, size=n).astype(np.int32))
+
+    def hist(**env):
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            G, H = hist_mod.level_histogram(bins, grad, hess, node, W, B)
+            return np.asarray(G), np.asarray(H)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    G0, H0 = hist(GRAFT_HIST_IMPL="flat")
+    assert G0[:, :, B - 1].any(), "fixture must exercise the missing bin"
+    # f32 exactly; bf16x2 (production default) to split-precision tolerance;
+    # bf16 to operand-rounding tolerance — all three run the aligned miss dot
+    for prec, atol in (("f32", 2e-4), ("bf16x2", 5e-3), ("bf16", 0.2)):
+        for impl in ("matmul", "pallas"):
+            for align in ("0", "1"):
+                G1, H1 = hist(
+                    GRAFT_HIST_IMPL=impl,
+                    GRAFT_HIST_MM_PREC=prec,
+                    GRAFT_HIST_ALIGN=align,
+                )
+                msg = f"{impl} align={align} prec={prec}"
+                np.testing.assert_allclose(G1, G0, atol=atol, err_msg=msg)
+                np.testing.assert_allclose(H1, H0, atol=atol, err_msg=msg)
+
+
+def test_node_totals_onehot_matches_segment():
+    """GRAFT_TOTALS_IMPL=onehot (MXU contraction, no sort) must match the
+    segment_sum lowering used for last-level leaf weights."""
+    rng = np.random.RandomState(12)
+    n, W = 70000, 256  # > one chunk when GRAFT_HIST_CHUNK=65536
+    grad = jnp.asarray(rng.randn(n).astype(np.float32))
+    hess = jnp.asarray((rng.rand(n) + 0.1).astype(np.float32))
+    node = jnp.asarray(rng.randint(-1, W, size=n).astype(np.int32))
+
+    def totals(impl):
+        old = os.environ.get("GRAFT_TOTALS_IMPL")
+        os.environ["GRAFT_TOTALS_IMPL"] = impl
+        try:
+            g, h = hist_mod.node_totals(grad, hess, node, W)
+            return np.asarray(g), np.asarray(h)
+        finally:
+            if old is None:
+                os.environ.pop("GRAFT_TOTALS_IMPL", None)
+            else:
+                os.environ["GRAFT_TOTALS_IMPL"] = old
+
+    g0, h0 = totals("segment")
+    g1, h1 = totals("onehot")
+    np.testing.assert_allclose(g1, g0, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(h1, h0, rtol=1e-4, atol=1e-3)

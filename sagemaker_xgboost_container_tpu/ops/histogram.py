@@ -34,7 +34,14 @@ import jax.numpy as jnp
 
 
 def _impl():
-    return os.environ.get("GRAFT_HIST_IMPL", "flat")
+    """Backend-aware default: the pallas one-hot matmul kernel is the
+    measured TPU winner (BASELINE.md round-2 probes: pallas 3.15 r/s vs
+    flat 0.265 on the bench config); the flat segment-sum wins on CPU.
+    GRAFT_HIST_IMPL overrides either way."""
+    v = os.environ.get("GRAFT_HIST_IMPL")
+    if v:
+        return v
+    return "pallas" if jax.default_backend() == "tpu" else "flat"
 
 
 def _matmul_chunk():
@@ -342,10 +349,11 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
 
 @functools.lru_cache(maxsize=None)
 def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
-    """Compiled pallas histogram: (bins i32 [n,d], gh f32 [n,2], node i32 [n,1])
-    -> [2W, d, B] f32. Grid over row blocks; VMEM-resident accumulator.
-    split_missing: see _mxu_split_missing (part of the cache key because the
-    kernel body changes with it)."""
+    """Compiled pallas histogram: (bins int [n,d] — any integer storage
+    dtype, widened per block in VMEM, so u8/u16 bins move half the HBM
+    bytes — gh f32 [n,2], node i32 [n,1]) -> [2W, d, B] f32. Grid over row
+    blocks; VMEM-resident accumulator. split_missing: see _mxu_split_missing
+    (part of the cache key because the kernel body changes with it)."""
     import jax.experimental.pallas as pl
 
     try:
@@ -380,9 +388,10 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
             A_lo = None
         else:
             A_hi, A_lo = A, None
+        bw = bins_ref[:].astype(jnp.int32)             # widen in VMEM
         iota_b = jax.lax.broadcasted_iota(jnp.int32, (block, Bm), 1)
         for f in range(d):
-            ob = (bins_ref[:, f][:, None] == iota_b)
+            ob = (bw[:, f][:, None] == iota_b)
             ob = ob.astype(A_hi.dtype)
             P = jax.lax.dot_general(
                 A_hi, ob, (((0,), (0,)), ((), ())),
@@ -395,7 +404,7 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
                 )
             out_ref[:, f, :Bm] += P
         if split_missing:
-            miss = (bins_ref[:] == (B - 1)).astype(A_hi.dtype)  # [blk, d]
+            miss = (bw == (B - 1)).astype(A_hi.dtype)  # [blk, d]
             Pm = jax.lax.dot_general(
                 A_hi, miss, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -452,5 +461,5 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
     fn = _pallas_hist_fn(
         n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B)
     )
-    GH = fn(bins.astype(jnp.int32), gh, node[:, None].astype(jnp.int32))
+    GH = fn(bins, gh, node[:, None].astype(jnp.int32))
     return GH[:W], GH[W:]

@@ -73,7 +73,6 @@ def build_tree_lossguide(
             "feature-axis sharding with grow_policy=lossguide is not supported yet"
         )
     n, d = bins.shape
-    bins = bins.astype(jnp.int32)
     max_nodes = 2 * max_leaves - 1
     depth_cap = max_depth if max_depth > 0 else max_leaves
 

@@ -39,8 +39,8 @@ def row_bin_lookup(bins, feat_idx):
     if os.environ.get("GRAFT_ROUTE_IMPL", "gather") == "onehot":
         d = bins.shape[1]
         oh = feat_idx[:, None] == jnp.arange(d, dtype=jnp.int32)[None, :]
-        return jnp.sum(jnp.where(oh, bins, 0), axis=1)
-    return jnp.take_along_axis(bins, feat_idx[:, None], axis=1)[:, 0]
+        return jnp.sum(jnp.where(oh, bins, 0).astype(jnp.int32), axis=1)
+    return jnp.take_along_axis(bins, feat_idx[:, None], axis=1)[:, 0].astype(jnp.int32)
 
 
 def max_nodes_for_depth(max_depth):
@@ -97,7 +97,9 @@ def build_tree(
     """
     n, d = bins.shape
     max_nodes = max_nodes_for_depth(max_depth)
-    bins = bins.astype(jnp.int32)
+    # bins stay in their storage dtype (u8/u16 from binning) end to end:
+    # every consumer widens inside a fused op, so no [n, d] i32 copy is ever
+    # materialized in HBM and the hot-loop bin reads move half the bytes
 
     tree = {
         "feature": jnp.zeros(max_nodes, jnp.int32),
@@ -405,7 +407,6 @@ def predict_binned(tree, bins, max_depth, num_bins):
     training cuts, so bin comparison == float comparison).
     """
     n = bins.shape[0]
-    bins = bins.astype(jnp.int32)
 
     def cond(state):
         i, node = state

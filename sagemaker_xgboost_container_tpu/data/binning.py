@@ -82,13 +82,19 @@ def _select_cuts(sorted_values, sorted_weights, max_cuts):
 
 
 def compute_cut_points(features, weights=None, max_bin=256):
-    """Per-feature cut thresholds via weighted quantiles. NaN = missing."""
+    """Per-feature cut thresholds via weighted quantiles. NaN = missing.
+
+    ``max_bin=None`` selects EVERY adjacent-distinct midpoint (no quantile
+    subsetting) — the candidate set and thresholds of xgboost's exact greedy
+    enumeration (reference tree_method=exact, schema
+    hyperparameter_validation.py:22-24), made static-shape by binning.
+    """
     n, d = features.shape
-    if max_bin < 2:
+    if max_bin is not None and max_bin < 2:
         raise exc.UserError("max_bin must be at least 2")
     w = np.ones(n, dtype=np.float32) if weights is None else weights
     cuts = []
-    max_cuts = max_bin - 1
+    max_cuts = n if max_bin is None else max_bin - 1
     order = np.argsort(features, axis=0, kind="stable")
     for f in range(d):
         col = features[order[:, f], f]
@@ -111,12 +117,35 @@ def apply_cut_points(features, cut_points, max_bin):
     return bins
 
 
-def bin_matrix(dmatrix, max_bin=256, cut_points=None):
-    """DataMatrix -> BinnedMatrix (computing cuts unless provided)."""
+def bin_matrix(dmatrix, max_bin=256, cut_points=None, exact_cap=None):
+    """DataMatrix -> BinnedMatrix (computing cuts unless provided).
+
+    ``max_bin=None`` = exact-greedy binning: cuts at every adjacent-distinct
+    midpoint, and the bin width sized by the data (see compute_cut_points).
+    ``exact_cap`` bounds that data-driven width: per-node histograms are
+    O(nodes x features x bins), so pathologically many distinct values must
+    fail loudly rather than exhaust HBM.
+    """
     if cut_points is None:
         cut_points = compute_cut_points(dmatrix.features, dmatrix.weights, max_bin)
     longest = max((len(c) for c in cut_points), default=0)
-    if longest + 1 > max_bin:
+    if max_bin is None:
+        max_bin = longest + 1
+        if exact_cap is not None and max_bin > exact_cap:
+            raise exc.UserError(
+                "tree_method='exact' needs {} bins for this data (one per "
+                "distinct feature value), above the TPU exact cap of {}. Use "
+                "tree_method='hist' (quantile binning), or raise "
+                "GRAFT_EXACT_BIN_CAP if the memory cost is acceptable.".format(
+                    max_bin, exact_cap
+                )
+            )
+        if max_bin + 1 > 65536:
+            raise exc.AlgorithmError(
+                "exact binning needs {} bins; the uint16 bin matrix holds "
+                "at most 65535".format(max_bin)
+            )
+    elif longest + 1 > max_bin:
         raise exc.AlgorithmError(
             "cut selection produced {} cuts for max_bin {}".format(longest, max_bin)
         )

@@ -22,6 +22,7 @@ stop, and monitor callbacks port naturally.
 """
 
 import logging
+import os
 from functools import partial
 
 import jax
@@ -90,17 +91,19 @@ class TrainConfig:
         self.gamma = float(p.get("gamma", 0.0))
         self.min_child_weight = float(p.get("min_child_weight", 1.0))
         self.max_delta_step = float(p.get("max_delta_step", 0.0))
-        if p.get("max_bin") is not None:
+        self.exact_binning = p.get("tree_method") == "exact"
+        if self.exact_binning:
+            # True exact-greedy parity: hist with cuts at EVERY adjacent
+            # distinct-value midpoint is the same candidate-split set and the
+            # same midpoint thresholds as libxgboost's exact enumeration
+            # (reference schema hyperparameter_validation.py:22-24), but
+            # static-shape. max_bin is sized by the data at binning time
+            # (bin_matrix(max_bin=None)), bounded by the cap below; xgboost
+            # likewise ignores max_bin for exact.
+            self.max_bin = None
+            self.exact_bin_cap = int(os.environ.get("GRAFT_EXACT_BIN_CAP", 8192))
+        elif p.get("max_bin") is not None:
             self.max_bin = int(p["max_bin"])
-        elif p.get("tree_method") == "exact":
-            # the reference's exact greedy enumerates every unique value
-            # (libxgboost updater; schema hyperparameter_validation.py:22-24).
-            # Enumeration is shape-dynamic — hostile to XLA — so exact maps to
-            # the hist engine at 4x default sketch resolution, the closest
-            # static-shape approximation; documented in MIGRATION.md. Checked
-            # before sketch_eps: that knob is approx-only and a stale value
-            # must not degrade exact to a handful of bins.
-            self.max_bin = 1024
         elif p.get("sketch_eps"):
             # approx-method users control sketch granularity via sketch_eps;
             # bins ~ 1/eps is xgboost's own guidance for the hist equivalent
@@ -336,13 +339,25 @@ class _TrainingSession:
 
         shared_cuts = None
         if self.is_multiprocess:
+            if config.max_bin is None:
+                # libxgboost's exact updater is likewise single-machine only
+                raise exc.UserError(
+                    "tree_method='exact' does not support distributed "
+                    "training (it doesn't in XGBoost either); use "
+                    "tree_method='hist'."
+                )
             # every host must bin with identical thresholds or the psum'd
             # histograms are meaningless: merge the per-host quantile sketches
             # (allgather candidate cuts, union, re-select) — the TPU analog of
             # xgboost's allreduced weighted quantile sketch
             shared_cuts = _merged_distributed_cuts(dtrain, config.max_bin)
 
-        self.train_binned = bin_matrix(dtrain, config.max_bin, cut_points=shared_cuts)
+        self.train_binned = bin_matrix(
+            dtrain,
+            config.max_bin,
+            cut_points=shared_cuts,
+            exact_cap=getattr(config, "exact_bin_cap", None),
+        )
         self.cuts = self.train_binned.cut_points
         self.eval_sets = []
         for dm, name in evals:

@@ -69,7 +69,9 @@ def train_cv_parallel(
     F = min(len(devices), K)
     K_pad = -(-K // F) * F
 
-    binned = bin_matrix(dmatrix, config.max_bin)
+    binned = bin_matrix(
+        dmatrix, config.max_bin, exact_cap=getattr(config, "exact_bin_cap", None)
+    )
     n, d = binned.bins.shape
     num_bins = binned.num_bins
     labels = np.asarray(dmatrix.labels, np.float32)

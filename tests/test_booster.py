@@ -329,20 +329,70 @@ def test_pred_leaf():
 
 
 def test_tree_method_binning_map():
-    """tree_method mapping: exact -> 1024-bin hist (closest static-shape
-    approximation of exact greedy, MIGRATION.md); approx -> bins ~ 1/sketch_eps;
-    explicit max_bin always wins."""
+    """tree_method mapping: exact -> data-sized bins (true exact-greedy
+    candidate set; max_bin ignored, as xgboost ignores it for exact);
+    approx -> bins ~ 1/sketch_eps; explicit max_bin wins for hist."""
     from sagemaker_xgboost_container_tpu.models.booster import TrainConfig
 
-    assert TrainConfig({"tree_method": "exact"}).max_bin == 1024
-    assert TrainConfig({"tree_method": "exact", "max_bin": 64}).max_bin == 64
+    cfg = TrainConfig({"tree_method": "exact"})
+    assert cfg.max_bin is None and cfg.exact_binning
+    assert TrainConfig({"tree_method": "exact", "max_bin": 64}).max_bin is None
     assert TrainConfig({"tree_method": "approx", "sketch_eps": 0.01}).max_bin == 100
     assert TrainConfig({}).max_bin == 256
 
 
 def test_exact_wins_over_stale_sketch_eps():
-    """A leftover approx-only sketch_eps must not degrade tree_method=exact
-    to a handful of bins."""
+    """A leftover approx-only sketch_eps must not affect tree_method=exact."""
     from sagemaker_xgboost_container_tpu.models.booster import TrainConfig
 
-    assert TrainConfig({"tree_method": "exact", "sketch_eps": 0.3}).max_bin == 1024
+    assert TrainConfig({"tree_method": "exact", "sketch_eps": 0.3}).max_bin is None
+
+
+def test_exact_matches_bruteforce_greedy():
+    """tree_method=exact must reproduce the brute-force exact-greedy oracle
+    even when distinct values far exceed the hist default of 256 bins —
+    cuts land at EVERY adjacent-distinct midpoint (reference exact updater
+    semantics, schema hyperparameter_validation.py:22-24)."""
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(9)
+    n = 700  # > 2x256 distinct values per feature, so hist-256 would differ
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (X[:, 0] * 1.5 + np.sin(3 * X[:, 1]) + 0.1 * rng.randn(n)).astype(
+        np.float32
+    )
+    d = DataMatrix(X, labels=y)
+    f_exact = train(
+        {"tree_method": "exact", "max_depth": 3, "eta": 1.0},
+        d,
+        num_boost_round=1,
+    )
+    t = f_exact.trees[0]
+
+    # brute-force greedy root split over all midpoints (exact semantics)
+    def best_split(X, g, h, lam=1.0):
+        best = (-np.inf, None, None)
+        G, H = g.sum(), h.sum()
+        parent = G * G / (H + lam)
+        for f in range(X.shape[1]):
+            vals = np.unique(X[:, f])
+            for lo, hi in zip(vals[:-1], vals[1:]):
+                thr = (lo + hi) / 2.0
+                m = X[:, f] < thr
+                Gl, Hl = g[m].sum(), h[m].sum()
+                gain = (
+                    Gl * Gl / (Hl + lam)
+                    + (G - Gl) ** 2 / (H - Hl + lam)
+                    - parent
+                ) / 2.0
+                if gain > best[0]:
+                    best = (gain, f, thr)
+        return best
+
+    g = np.full(n, f_exact.base_score) - y  # squarederror grad at round 0
+    h = np.ones(n)
+    gain, feat, thr = best_split(X, g, h)
+    assert t.feature[0] == feat
+    # stored threshold is the midpoint between adjacent distinct values
+    np.testing.assert_allclose(t.threshold[0], thr, rtol=1e-5)

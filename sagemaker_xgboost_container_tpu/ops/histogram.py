@@ -347,12 +347,30 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
 # ------------------------------------------------------------------- pallas
 
 
+def _vnode_factor(W, block):
+    """Virtual-node packing factor: the MXU processes M in 128-row tiles, so
+    a [blk, 2W] @ [blk, B] dot with 2W < 128 pads M and wastes (128/2W)x the
+    FLOPs — the histogram cost of a SHALLOW level would match the deepest
+    level's. Packing v = 128//(2W) row sub-groups as disjoint virtual node
+    ranges fills the tile with real work; the v partial histograms sum after
+    the grid. Exact (pure reassociation of the sum). GRAFT_HIST_VNODES=0
+    disables for A/B."""
+    if os.environ.get("GRAFT_HIST_VNODES", "1") != "1":
+        return 1
+    v = max(1, 128 // (2 * W))
+    while block % v:  # keep sub-groups equal-sized (block is 2^k anyway)
+        v //= 2
+    return v
+
+
 @functools.lru_cache(maxsize=None)
-def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
+def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing, v):
     """Compiled pallas histogram: (bins int [n,d] — any integer storage
     dtype, widened per block in VMEM, so u8/u16 bins move half the HBM
-    bytes — gh f32 [n,2], node i32 [n,1]) -> [2W, d, B] f32. Grid over row
-    blocks; VMEM-resident accumulator. split_missing: see _mxu_split_missing
+    bytes — gh f32 [n,2], node i32 [n,1]) -> [2*W*v, d, B] f32 with the g
+    histograms in rows [:W*v] and h in [W*v:], v sub-group copies each
+    (see _vnode_factor; the caller reduces them). Grid over row blocks;
+    VMEM-resident accumulator. split_missing: see _mxu_split_missing
     (part of the cache key because the kernel body changes with it)."""
     import jax.experimental.pallas as pl
 
@@ -365,6 +383,7 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
         vmem = None
 
     Bm = B - 1 if split_missing else B
+    Wv = W * v
 
     def kernel(bins_ref, gh_ref, node_ref, out_ref):
         step = pl.program_id(0)
@@ -374,13 +393,18 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
             out_ref[:] = jnp.zeros_like(out_ref)
 
         node = node_ref[:, 0]                          # [blk]
+        if v > 1:
+            # row i -> virtual node range (i % v); dead rows (node == W)
+            # must stay out of EVERY range, not collide with range (s+1)
+            s = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0] % v
+            node = jnp.where(node >= W, Wv, node + s * W)
         onehot_w = (node[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (block, W), 1)).astype(jnp.float32)
+            jnp.int32, (block, Wv), 1)).astype(jnp.float32)
         g = gh_ref[:, 0]
         h = gh_ref[:, 1]
         A = jnp.concatenate(
             [onehot_w * g[:, None], onehot_w * h[:, None]], axis=1
-        )  # [blk, 2W]
+        )  # [blk, 2*Wv]
         if prec == "bf16x2":
             A_hi, A_lo = _split_bf16(A)
         elif prec == "bf16":
@@ -430,8 +454,8 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing):
             pl.BlockSpec((block, 2), lambda i: (i, 0), **in_space),
             pl.BlockSpec((block, 1), lambda i: (i, 0), **in_space),
         ],
-        out_specs=pl.BlockSpec((2 * W, d, B), lambda i: (0, 0, 0), **in_space),
-        out_shape=jax.ShapeDtypeStruct((2 * W, d, B), jnp.float32),
+        out_specs=pl.BlockSpec((2 * Wv, d, B), lambda i: (0, 0, 0), **in_space),
+        out_shape=jax.ShapeDtypeStruct((2 * Wv, d, B), jnp.float32),
         interpret=interpret,
     )
 
@@ -458,8 +482,14 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
         bins = jnp.pad(bins, pad + [(0, 0)])
 
     gh = jnp.stack([g, h], axis=1)                     # [n, 2]
+    v = _vnode_factor(W, block)
     fn = _pallas_hist_fn(
-        n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B)
+        n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B), v
     )
     GH = fn(bins, gh, node[:, None].astype(jnp.int32))
+    if v > 1:
+        Wv = W * v
+        G = GH[:Wv].reshape(v, W, d, B).sum(axis=0)
+        H = GH[Wv:].reshape(v, W, d, B).sum(axis=0)
+        return G, H
     return GH[:W], GH[W:]

@@ -13,6 +13,8 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -159,6 +161,96 @@ int libsvm_fill(const char* buf, int64_t len, float* labels, float* weights,
         indptr[row] = k;
         skip_line(c);
     }
+    return 0;
+}
+
+// ---------------------------------------------------------------- parallel
+//
+// Multi-threaded two-pass parse: the buffer splits into nchunks
+// newline-aligned chunks (boundaries derived identically in count and fill,
+// so the passes always agree), each chunk parsed independently. Python
+// prefix-sums the per-chunk counts into row/nnz bases for the fill. Error
+// lines are chunk-local; the (rare) error path re-runs the single-threaded
+// counter for an exact global line number.
+
+static int64_t chunk_start(const char* buf, int64_t len, int32_t nchunks,
+                           int32_t i) {
+    if (i <= 0) return 0;
+    if (i >= nchunks) return len;
+    int64_t pos = len * static_cast<int64_t>(i) / nchunks;
+    const char* nl =
+        static_cast<const char*>(memchr(buf + pos, '\n', len - pos));
+    return nl ? (nl - buf) + 1 : len;
+}
+
+extern "C" int libsvm_count_mt(const char* buf, int64_t len, int32_t nchunks,
+                               LibsvmInfo* merged, LibsvmInfo* per_chunk) {
+    std::vector<std::thread> ts;
+    ts.reserve(nchunks);
+    for (int32_t i = 0; i < nchunks; ++i) {
+        int64_t s = chunk_start(buf, len, nchunks, i);
+        int64_t e = chunk_start(buf, len, nchunks, i + 1);
+        ts.emplace_back([buf, s, e, i, per_chunk]() {
+            libsvm_count(buf + s, e - s, &per_chunk[i]);
+        });
+    }
+    for (auto& t : ts) t.join();
+    merged->n_rows = 0;
+    merged->nnz = 0;
+    merged->max_index = -1;
+    merged->has_weights = 0;
+    merged->has_qids = 0;
+    merged->error_line = 0;
+    for (int32_t i = 0; i < nchunks; ++i) {
+        const LibsvmInfo& ci = per_chunk[i];
+        if (ci.error_line) {
+            merged->error_line = ci.error_line;  // chunk-local; caller refines
+            return 1;
+        }
+        merged->n_rows += ci.n_rows;
+        merged->nnz += ci.nnz;
+        if (ci.max_index > merged->max_index) merged->max_index = ci.max_index;
+        merged->has_weights |= ci.has_weights;
+        merged->has_qids |= ci.has_qids;
+    }
+    return 0;
+}
+
+extern "C" int libsvm_fill_mt(const char* buf, int64_t len, int32_t nchunks,
+                              const LibsvmInfo* per_chunk, float* labels,
+                              float* weights, int64_t* qids, int64_t* indices,
+                              float* values, int64_t* indptr) {
+    std::vector<int64_t> row_base(nchunks + 1, 0), nnz_base(nchunks + 1, 0);
+    for (int32_t i = 0; i < nchunks; ++i) {
+        row_base[i + 1] = row_base[i] + per_chunk[i].n_rows;
+        nnz_base[i + 1] = nnz_base[i] + per_chunk[i].nnz;
+    }
+    std::vector<std::thread> ts;
+    std::vector<int> rcs(nchunks, 0);
+    ts.reserve(nchunks);
+    indptr[0] = 0;
+    for (int32_t i = 0; i < nchunks; ++i) {
+        int64_t s = chunk_start(buf, len, nchunks, i);
+        int64_t e = chunk_start(buf, len, nchunks, i + 1);
+        int64_t rb = row_base[i], nb = nnz_base[i];
+        ts.emplace_back([=, &rcs]() {
+            // fill into a chunk-local indptr, then publish entries
+            // 1..n_rows rebased by nb: entry rb (== previous chunk's last)
+            // belongs to the previous chunk — writing it here would race
+            int64_t n_rows = per_chunk[i].n_rows;
+            std::vector<int64_t> local(n_rows + 1);
+            rcs[i] = libsvm_fill(buf + s, e - s, labels + rb, weights + rb,
+                                 qids ? qids + rb : nullptr, indices + nb,
+                                 values + nb, local.data());
+            if (rcs[i] == 0) {
+                for (int64_t r = 1; r <= n_rows; ++r)
+                    indptr[rb + r] = local[r] + nb;
+            }
+        });
+    }
+    for (auto& t : ts) t.join();
+    for (int32_t i = 0; i < nchunks; ++i)
+        if (rcs[i]) return 1;
     return 0;
 }
 

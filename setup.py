@@ -18,7 +18,8 @@ def read(fname):
 fastdata_ext = Extension(
     "sagemaker_xgboost_container_tpu._fastdata",
     sources=["native/fastdata.cpp"],
-    extra_compile_args=["-O3"],
+    extra_compile_args=["-O3", "-pthread"],
+    extra_link_args=["-pthread"],
     optional=True,
 )
 

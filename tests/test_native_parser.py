@@ -86,3 +86,40 @@ def test_throughput_not_slower_than_python():
     # the native path should be dramatically faster; assert a loose bound so
     # CI noise can't flake it
     assert t_native < t_python, (t_native, t_python)
+
+
+def test_multithreaded_parse_matches_single(monkeypatch):
+    """The chunked parallel parse (libsvm_count_mt/fill_mt) must produce
+    byte-identical CSR pieces to the single-threaded path — newline-aligned
+    chunking, prefix-summed row/nnz bases, no indptr boundary overlap.
+    (This container has 1 CPU, so the MT path only engages via the
+    GRAFT_PARSE_THREADS override; multi-core training hosts take it
+    automatically for multi-MB payloads.)"""
+    rng = np.random.RandomState(5)
+    lines = []
+    for i in range(5000):
+        idx = np.sort(rng.choice(40, size=rng.randint(1, 12), replace=False))
+        feats = " ".join("{}:{:.4f}".format(j, rng.randn()) for j in idx)
+        w = ":{:.2f}".format(rng.rand()) if i % 3 == 0 else ""
+        lines.append("{:.3f}{} qid:{} {}".format(rng.randn(), w, i // 50, feats))
+    blob = ("\n".join(lines) + "\n").encode()
+
+    if not native.native_available():
+        pytest.skip("no compiler")
+    monkeypatch.setenv("GRAFT_PARSE_THREADS", "1")
+    ref = native.parse_libsvm_native(blob)
+    monkeypatch.setenv("GRAFT_PARSE_THREADS", "5")  # uneven chunking
+    mt = native.parse_libsvm_native(blob)
+    (v0, i0, p0), l0, w0, q0 = ref
+    (v1, i1, p1), l1, w1, q1 = mt
+    np.testing.assert_array_equal(p0, p1)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(v0, v1)
+    np.testing.assert_array_equal(l0, l1)
+    np.testing.assert_array_equal(w0, w1)
+    np.testing.assert_array_equal(q0, q1)
+
+    # malformed input under MT still reports the exact global line number
+    bad = blob + b"7 3:oops 4:x\n"
+    with pytest.raises(ValueError, match=str(len(lines) + 1)):
+        native.parse_libsvm_native(bad)

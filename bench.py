@@ -61,17 +61,23 @@ def _supervised_main():
     if os.environ.get("GRAFT_HIST_IMPL"):
         configs = [(os.environ["GRAFT_HIST_IMPL"], {})]
     else:
-        # impl x operand-precision matrix (quality-validated: bf16 one-hot
-        # matmul matches f32 val-logloss/auc on the bench task, BASELINE.md)
-        # precision pinned in every entry: an inherited GRAFT_HIST_MM_PREC
-        # would otherwise silently collapse the A/B
+        # impl x operand-precision x lowering matrix (bf16 operands are
+        # quality-validated: matches f32 val-logloss/auc on the bench task,
+        # BASELINE.md). Every knob pinned in every entry: an inherited env
+        # would otherwise silently collapse the A/B. vnodes=0 probes guard
+        # against the virtual-node packing regressing on real hardware.
+        base = {"GRAFT_HIST_MM_PREC": "bf16x2", "GRAFT_HIST_VNODES": "1"}
         configs = [
-            ("flat", {"GRAFT_HIST_IMPL": "flat", "GRAFT_HIST_MM_PREC": "bf16x2"}),
-            ("matmul", {"GRAFT_HIST_IMPL": "matmul", "GRAFT_HIST_MM_PREC": "bf16x2"}),
-            ("pallas", {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16x2"}),
+            ("flat", dict(base, GRAFT_HIST_IMPL="flat")),
+            ("matmul", dict(base, GRAFT_HIST_IMPL="matmul")),
+            ("pallas", dict(base, GRAFT_HIST_IMPL="pallas")),
+            (
+                "pallas,vnodes=0",
+                dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_HIST_VNODES="0"),
+            ),
             (
                 "pallas,prec=bf16",
-                {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16"},
+                dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_HIST_MM_PREC="bf16"),
             ),
         ]
     note = "no probe succeeded"
@@ -133,6 +139,37 @@ def _make_data(n, d, seed=0):
     return X, y
 
 
+def _task_setup(n, d, seed=0):
+    """BENCH_TASK selects the measured workload: ``binary`` (default; BASELINE
+    config #2 Higgs-like), ``multiclass`` (#3 CoverType-like, 7 classes), or
+    ``ranking`` (#4 MSLR-like LambdaMART, ~100-doc groups). Returns
+    (DataMatrix kwargs-ready pieces, params dict, task label)."""
+    task = os.getenv("BENCH_TASK", "binary")
+    rng = np.random.RandomState(seed)
+    X, y = _make_data(n, d, seed)
+    groups = None
+    if task == "binary":
+        params = {"objective": "binary:logistic"}
+    elif task == "multiclass":
+        score = X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(n)
+        y = np.digitize(score, np.quantile(score, np.linspace(0, 1, 8)[1:-1]))
+        y = y.astype(np.float32)
+        params = {"objective": "multi:softmax", "num_class": 7}
+    elif task == "ranking":
+        rel = X[:, 0] + np.sin(X[:, 1]) + 0.5 * rng.randn(n)
+        y = np.digitize(rel, np.quantile(rel, [0.5, 0.75, 0.9, 0.97])).astype(
+            np.float32
+        )
+        group_size = 100
+        groups = np.full(n // group_size, group_size, np.int64)
+        n_used = int(groups.sum())
+        X, y = X[:n_used], y[:n_used]
+        params = {"objective": "rank:ndcg"}
+    else:
+        raise ValueError("BENCH_TASK must be binary|multiclass|ranking")
+    return X, y, groups, params, task
+
+
 def main():
     # detect a dead accelerator backend up front; an honest, clearly-labeled
     # CPU number is more useful than a 0.0 placeholder
@@ -160,21 +197,25 @@ def main():
     )
     from sagemaker_xgboost_container_tpu.models.forest import Forest
 
-    X, y = _make_data(N_ROWS, N_FEATURES)
-    dtrain = DataMatrix(X, labels=y)
-    params = {
-        "objective": "binary:logistic",
-        "max_depth": MAX_DEPTH,
-        "eta": 0.2,
-        "tree_method": "hist",
-        "max_bin": 256,
-        "_rounds_per_dispatch": int(os.getenv("BENCH_ROUNDS_PER_DISPATCH", "10")),
-    }
+    X, y, groups, task_params, task = _task_setup(N_ROWS, N_FEATURES)
+    dtrain = DataMatrix(X, labels=y, groups=groups)
+    params = dict(
+        task_params,
+        max_depth=MAX_DEPTH,
+        eta=0.2,
+        tree_method="hist",
+        max_bin=256,
+        _rounds_per_dispatch=int(os.getenv("BENCH_ROUNDS_PER_DISPATCH", "10")),
+    )
     config = TrainConfig(params)
     forest = Forest(
         objective_name=config.objective,
+        objective_params={"num_class": config.num_class}
+        if config.num_class
+        else None,
         base_score=config.base_score,
         num_feature=dtrain.num_col,
+        num_class=config.num_class,
     )
     session = _TrainingSession(config, dtrain, [], forest)
 
@@ -196,8 +237,8 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "boosting rounds/sec (synthetic Higgs-like, {} rows x {} feat, depth {}, binary:logistic){}".format(
-                    N_ROWS, N_FEATURES, MAX_DEPTH, backend_note
+                "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, depth {}, {}){}".format(
+                    N_ROWS, N_FEATURES, MAX_DEPTH, params["objective"], backend_note
                 ),
                 "value": round(rounds_per_sec, 3),
                 "unit": "rounds/sec",

@@ -347,20 +347,27 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
 # ------------------------------------------------------------------- pallas
 
 
-def _vnode_factor(W, block):
+def _vnode_factor(W, block, d, B):
     """Virtual-node packing factor: the MXU processes M in 128-row tiles, so
     a [blk, 2W] @ [blk, B] dot with 2W < 128 pads M and wastes (128/2W)x the
     FLOPs — the histogram cost of a SHALLOW level would match the deepest
     level's. Packing v = 128//(2W) row sub-groups as disjoint virtual node
     ranges fills the tile with real work; the v partial histograms sum after
     the grid. Exact (pure reassociation of the sum). GRAFT_HIST_VNODES=0
-    disables for A/B."""
+    disables for A/B.
+
+    The VMEM accumulator grows to [2*W*v, d, B] f32, so v is also capped by
+    GRAFT_VNODE_VMEM (default 4MB) — shallow levels of WIDE matrices must
+    not allocate more VMEM than the deepest level the kernel already
+    handles."""
     if os.environ.get("GRAFT_HIST_VNODES", "1") != "1":
         return 1
+    budget = int(os.environ.get("GRAFT_VNODE_VMEM", 4 * 1024 * 1024))
     v = max(1, 128 // (2 * W))
-    while block % v:  # keep sub-groups equal-sized (block is 2^k anyway)
-        v //= 2
-    return v
+    v = min(v, max(1, budget // (2 * W * d * B * 4)))
+    while block % v or v & (v - 1):  # equal sub-groups; power of two
+        v -= 1
+    return max(1, v)
 
 
 @functools.lru_cache(maxsize=None)
@@ -482,7 +489,7 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
         bins = jnp.pad(bins, pad + [(0, 0)])
 
     gh = jnp.stack([g, h], axis=1)                     # [n, 2]
-    v = _vnode_factor(W, block)
+    v = _vnode_factor(W, block, d, B)
     fn = _pallas_hist_fn(
         n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B), v
     )

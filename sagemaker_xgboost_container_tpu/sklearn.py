@@ -94,6 +94,27 @@ class _BaseEstimator(_SKBase):
     def save_model(self, path):
         self.booster_.save_model(path)
 
+    @property
+    def feature_importances_(self):
+        """Normalized per-feature importances (xgboost sklearn semantics:
+        ``gain``-based for tree boosters, summing to 1; unused features 0)."""
+        self._check_fitted()
+        forest = self._model
+        names = forest.feature_names
+        score = forest.get_score(importance_type="gain")
+        n = forest.num_feature or len(names or ()) or len(score)
+        out = np.zeros(n, np.float32)
+        for key, val in score.items():
+            if names and key in names:
+                idx = names.index(key)
+            else:
+                idx = int(key[1:]) if key.startswith("f") else int(key)
+            if idx >= out.size:
+                out = np.resize(out, idx + 1)
+            out[idx] = val
+        total = out.sum()
+        return out / total if total > 0 else out
+
 
 class TPUXGBRegressor(_SKRegressorMixin, _BaseEstimator):
     _objective = "reg:squarederror"

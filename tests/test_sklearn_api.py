@@ -58,3 +58,19 @@ def test_ranker():
     ranker.fit(X, y, group=np.full(20, 10))
     s = ranker.predict(X)
     assert np.corrcoef(s, y)[0, 1] > 0.5
+
+
+def test_feature_importances_property():
+    """feature_importances_ (xgboost sklearn semantics): gain-normalized,
+    length num_feature, zeros for unused features, sums to 1."""
+    from sagemaker_xgboost_container_tpu.sklearn import TPUXGBRegressor
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(500, 6).astype(np.float32)
+    y = (3 * X[:, 0] + X[:, 4]).astype(np.float32)  # features 0 and 4 matter
+    est = TPUXGBRegressor(n_estimators=8, max_depth=3).fit(X, y)
+    imp = est.feature_importances_
+    assert imp.shape == (6,)
+    np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-5)
+    assert imp[0] == imp.max()
+    assert imp[np.argsort(imp)[:2]].sum() < 0.1  # irrelevant features ~0

@@ -379,3 +379,32 @@ def test_vnode_packing_matches_flat():
         )
         np.testing.assert_allclose(G1, G0, atol=2e-4, err_msg=f"W={W}")
         np.testing.assert_allclose(H1, H0, atol=2e-4, err_msg=f"W={W}")
+
+
+def test_multiclass_vmap_over_pallas():
+    """Multiclass training vmaps the tree builder over classes; the pallas
+    histogram kernel must survive the vmap batching rule (bench BENCH_TASK=
+    multiclass exercises this on hardware)."""
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(21)
+    X = rng.randn(900, 4).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(
+        np.float32
+    )
+    params = {"objective": "multi:softprob", "num_class": 3, "max_depth": 3}
+    old = os.environ.get("GRAFT_HIST_IMPL")
+    try:
+        os.environ["GRAFT_HIST_IMPL"] = "pallas"
+        f1 = train(dict(params), DataMatrix(X, labels=y), num_boost_round=2)
+        os.environ["GRAFT_HIST_IMPL"] = "flat"
+        f0 = train(dict(params), DataMatrix(X, labels=y), num_boost_round=2)
+    finally:
+        if old is None:
+            os.environ.pop("GRAFT_HIST_IMPL", None)
+        else:
+            os.environ["GRAFT_HIST_IMPL"] = old
+    np.testing.assert_allclose(
+        np.asarray(f1.predict(X)), np.asarray(f0.predict(X)), atol=1e-4
+    )

@@ -92,6 +92,7 @@ class TrainConfig:
         self.min_child_weight = float(p.get("min_child_weight", 1.0))
         self.max_delta_step = float(p.get("max_delta_step", 0.0))
         self.exact_binning = p.get("tree_method") == "exact"
+        self.exact_bin_cap = None
         if self.exact_binning:
             # True exact-greedy parity: hist with cuts at EVERY adjacent
             # distinct-value midpoint is the same candidate-split set and the
@@ -356,7 +357,7 @@ class _TrainingSession:
             dtrain,
             config.max_bin,
             cut_points=shared_cuts,
-            exact_cap=getattr(config, "exact_bin_cap", None),
+            exact_cap=config.exact_bin_cap,
         )
         self.cuts = self.train_binned.cut_points
         self.eval_sets = []

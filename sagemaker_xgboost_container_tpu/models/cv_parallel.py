@@ -69,9 +69,7 @@ def train_cv_parallel(
     F = min(len(devices), K)
     K_pad = -(-K // F) * F
 
-    binned = bin_matrix(
-        dmatrix, config.max_bin, exact_cap=getattr(config, "exact_bin_cap", None)
-    )
+    binned = bin_matrix(dmatrix, config.max_bin, exact_cap=config.exact_bin_cap)
     n, d = binned.bins.shape
     num_bins = binned.num_bins
     labels = np.asarray(dmatrix.labels, np.float32)
@@ -94,7 +92,7 @@ def train_cv_parallel(
     fold_sharding = NamedSharding(mesh, P("fold"))
     repl = NamedSharding(mesh, P())
 
-    bins_dev = jax.device_put(binned.bins.astype(np.int32), repl)
+    bins_dev = jax.device_put(binned.bins, repl)  # u8/u16 stays narrow on device
     labels_dev = jax.device_put(labels, repl)
     num_cuts_dev = jax.device_put(
         np.array([len(c) for c in binned.cut_points], np.int32), repl

@@ -110,7 +110,7 @@ class _BaseEstimator(_SKBase):
             else:
                 idx = int(key[1:]) if key.startswith("f") else int(key)
             if idx >= out.size:
-                out = np.resize(out, idx + 1)
+                out = np.pad(out, (0, idx + 1 - out.size))  # zero-filled
             out[idx] = val
         total = out.sum()
         return out / total if total > 0 else out

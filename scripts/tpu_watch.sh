@@ -3,7 +3,9 @@
 # run the per-stage dissection (pallas + route A/B) and the serving bench,
 # then exit so the harness surfaces the results. Artifacts in .tpuwatch/.
 set -u
-cd "$(dirname "$0")/.."
+# GRAFT_REPO lets a frozen copy of this script (run from /tmp so mid-run
+# edits to the repo file can't corrupt the incremental bash parse) find home
+cd "${GRAFT_REPO:-/root/repo}"
 OUT=.tpuwatch
 mkdir -p "$OUT"
 PROBE='import jax; print(jax.devices()); import jax.numpy as j; print((j.ones((128,128))@j.ones((128,128))).sum())'
@@ -29,4 +31,6 @@ run 1500 dissect_pallas.log GRAFT_HIST_IMPL=pallas python scripts/dissect.py
 run 1200 dissect_novnodes.log GRAFT_HIST_IMPL=pallas GRAFT_HIST_VNODES=0 python scripts/dissect.py
 run 1200 dissect_onehot.log GRAFT_HIST_IMPL=pallas GRAFT_ROUTE_IMPL=onehot GRAFT_TOTALS_IMPL=onehot python scripts/dissect.py
 run 900 bench_serve.log python bench_serve.py
+run 1500 bench_multiclass.log GRAFT_HIST_IMPL=pallas BENCH_TASK=multiclass python bench.py
+run 1500 bench_ranking.log GRAFT_HIST_IMPL=pallas BENCH_TASK=ranking python bench.py
 echo "[watch] done $(date +%H:%M:%S)" >> "$OUT/watch.log"

@@ -204,6 +204,8 @@ def apply_cut_points(features, cut_points, max_bin):
     """Map float features to bin indices; NaN -> missing bin (== max_bin)."""
     n, d = features.shape
     dtype = np.uint8 if max_bin + 1 <= 256 else np.uint16
+    if n > 0 and d > 0 and _sketch_impl() == "device":
+        return _device_apply(features, cut_points, max_bin, dtype)
     bins = np.empty((n, d), dtype=dtype)
     for f in range(d):
         col = features[:, f]
@@ -211,6 +213,39 @@ def apply_cut_points(features, cut_points, max_bin):
         idx[np.isnan(col)] = max_bin
         bins[:, f] = idx.astype(dtype)
     return bins
+
+
+def _device_apply(features, cut_points, max_bin, dtype):
+    """apply_cut_points as one vmapped on-device searchsorted (the binning
+    stage's other host loop, ~5s for 1M x 28). Cuts pad to [d, L] with +inf
+    (finite values never land in the pad; +inf values clip to the feature's
+    true cut count, matching numpy searchsorted semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = features.shape[1]
+    L = max(1, max((len(c) for c in cut_points), default=1))
+    padded = np.full((d, L), np.inf, np.float32)
+    counts = np.zeros(d, np.int32)
+    for f, c in enumerate(cut_points):
+        padded[f, : len(c)] = c
+        counts[f] = len(c)
+
+    @jax.jit
+    def kernel(cols, cuts, cnts):
+        def one(col, cf, kf):
+            idx = jnp.searchsorted(cf, col, side="right")
+            idx = jnp.minimum(idx, kf)          # +inf values -> n_cuts
+            return jnp.where(jnp.isnan(col), max_bin, idx)
+
+        return jax.vmap(one)(cols, cuts, cnts)
+
+    out = kernel(
+        jnp.asarray(features.T, jnp.float32),
+        jnp.asarray(padded),
+        jnp.asarray(counts),
+    )
+    return np.asarray(out).T.astype(dtype)
 
 
 def bin_matrix(dmatrix, max_bin=256, cut_points=None, exact_cap=None):

@@ -94,3 +94,31 @@ def test_device_sketch_trains_equivalently():
     rmse_h = float(np.sqrt(np.mean((preds["host"] - y) ** 2)))
     rmse_d = float(np.sqrt(np.mean((preds["device"] - y) ** 2)))
     assert abs(rmse_h - rmse_d) < 0.02 * max(rmse_h, 1e-6), (rmse_h, rmse_d)
+
+
+def test_device_apply_matches_host():
+    """Device binning (vmapped searchsorted) == numpy apply_cut_points,
+    including NaN -> missing bin, +/-inf values, and empty cut lists."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(6000, 4).astype(np.float32)
+    X[rng.rand(6000, 4) < 0.1] = np.nan
+    X[0, 0] = np.inf
+    X[1, 1] = -np.inf
+    X[:, 3] = np.nan  # all-missing feature -> empty cuts
+    cuts = _cuts(X, None, 32, "host")
+    host_bins = None
+    for impl in ("host", "device"):
+        old = os.environ.get("GRAFT_SKETCH_IMPL")
+        os.environ["GRAFT_SKETCH_IMPL"] = impl
+        try:
+            b = binning.apply_cut_points(X, cuts, 32)
+        finally:
+            if old is None:
+                os.environ.pop("GRAFT_SKETCH_IMPL", None)
+            else:
+                os.environ["GRAFT_SKETCH_IMPL"] = old
+        if host_bins is None:
+            host_bins = b
+        else:
+            assert b.dtype == host_bins.dtype
+            np.testing.assert_array_equal(b, host_bins)

@@ -125,7 +125,9 @@ class TrainConfig:
         self.eval_metric = p.get("eval_metric")
         self.num_parallel_tree = int(p.get("num_parallel_tree", 1) or 1)
         self.booster = p.get("booster", "gbtree")
-        # internal: build K trees per device dispatch (only without eval sets)
+        # internal: build K trees per device dispatch (with eval sets the
+        # per-round metrics ride back as device-computed stats inside the
+        # scan; falls back to 1 when a metric can't — see _TrainingSession)
         self.rounds_per_dispatch = int(p.get("_rounds_per_dispatch", 1) or 1)
         self.objective_params = p
         if self.objective == "count:poisson" and "max_delta_step" not in p:

@@ -284,7 +284,9 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
 
     Per chunk: A[c, 2W] = node-one-hot * (grad | hess); per feature,
     P[2W, B] = A^T @ bin-one-hot[c, B]; accumulate into [2W, d, B] f32.
-    The MXU does the binning — no scatter anywhere.
+    The MXU does the binning — no scatter anywhere. Virtual-node packing
+    (see _vnode_factor) fills the M tile at shallow levels exactly as in
+    the pallas kernel.
     """
     n, d = bins.shape
     W = num_nodes
@@ -294,10 +296,15 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         z = jnp.zeros((W, d, B), jnp.float32)
         return z, z
 
+    v = _vnode_factor(W, 1, d, B)  # chunk rows needn't divide v here
+    Wv = W * v
     active = node_local >= 0
     g = jnp.where(active, grad, 0.0)
     h = jnp.where(active, hess, 0.0)
-    node = jnp.where(active, node_local, W)  # W = dead slot, one-hot -> 0
+    node = jnp.where(active, node_local, Wv)  # dead slot, one-hot -> 0
+    if v > 1:
+        s = (jnp.arange(n, dtype=jnp.int32) % v) * W
+        node = jnp.where(node >= Wv, Wv, node + s)
 
     chunk, steps = _balanced_chunks(n)
     n_pad = steps * chunk
@@ -305,12 +312,12 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         pad = [(0, n_pad - n)]
         g = jnp.pad(g, pad)
         h = jnp.pad(h, pad)
-        node = jnp.pad(node, pad, constant_values=W)
+        node = jnp.pad(node, pad, constant_values=Wv)
         bins = jnp.pad(bins, pad + [(0, 0)])
 
     split_missing = _mxu_split_missing(B)
     Bm = B - 1 if split_missing else B
-    iota_w = jnp.arange(W, dtype=jnp.int32)
+    iota_w = jnp.arange(Wv, dtype=jnp.int32)
     iota_b = jnp.arange(Bm, dtype=jnp.int32)
 
     def body(carry, i):
@@ -323,24 +330,28 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         onehot_w = (node_c[:, None] == iota_w[None, :]).astype(jnp.float32)
         A = jnp.concatenate(
             [onehot_w * g_c[:, None], onehot_w * h_c[:, None]], axis=1
-        )  # [c, 2W]
+        )  # [c, 2*Wv]
         per_f = []
         for f in range(d):
             Ob32 = (bins_c[:, f][:, None] == iota_b[None, :]).astype(jnp.float32)
             per_f.append(_dot_prec(A, Ob32, prec))
-        delta = jnp.stack(per_f, axis=1)  # [2W, d, Bm]
+        delta = jnp.stack(per_f, axis=1)  # [2*Wv, d, Bm]
         if split_missing:
             miss = (bins_c == (B - 1)).astype(jnp.float32)  # [c, d]
-            Pm = _dot_prec(A, miss, prec)  # [2W, d]
+            Pm = _dot_prec(A, miss, prec)  # [2*Wv, d]
             delta = jnp.concatenate([delta, Pm[:, :, None]], axis=2)
         GH = GH + delta
         return GH, None
 
-    init = jnp.zeros((2 * W, d, B), jnp.float32)
+    init = jnp.zeros((2 * Wv, d, B), jnp.float32)
     if steps == 1:
         GH, _ = body(init, jnp.int32(0))
     else:
         GH, _ = jax.lax.scan(body, init, jnp.arange(steps, dtype=jnp.int32))
+    if v > 1:
+        G = GH[:Wv].reshape(v, W, d, B).sum(axis=0)
+        H = GH[Wv:].reshape(v, W, d, B).sum(axis=0)
+        return G, H
     return GH[:W], GH[W:]
 
 

@@ -335,3 +335,28 @@ def test_script_mode_training(tmp_path):
     assert result.returncode == 0, result.stdout[-2000:] + result.stderr[-2000:]
     assert "USER_SCRIPT_DONE" in result.stdout
     assert (model_dir / "xgboost-model").exists()
+
+
+@pytest.mark.e2e
+def test_exact_tree_method_end_to_end(tmp_path):
+    """tree_method=exact through the real entrypoint: schema validation
+    accepts it, the data-sized all-midpoint binning engages (true
+    exact-greedy parity), HPO metric lines print, model saves and learns."""
+    env, model_dir, _ = _sm_env(
+        tmp_path,
+        {
+            "objective": "reg:squarederror",
+            "tree_method": "exact",
+            "max_depth": "4",
+            "eta": "0.3",
+            "num_round": "8",
+        },
+        {"train": LIBSVM_CHANNELS["train"]},
+        train_dir=os.path.join(ABALONE, "train"),
+    )
+    result = _run_train(env)
+    assert result.returncode == 0, result.stderr[-2000:]
+    lines = re.findall(r"\[(\d+)\]\ttrain-rmse:([0-9.]+)", result.stdout)
+    assert len(lines) == 8, result.stdout[-2000:]
+    assert float(lines[-1][1]) < float(lines[0][1]) * 0.5
+    assert (model_dir / "xgboost-model").exists()

@@ -769,3 +769,38 @@ def test_dart_mesh_matches_single_device(mesh8):
         np.asarray(dist.predict(X[:200])),
         rtol=1e-4, atol=1e-5,
     )
+
+
+def test_mesh_with_pallas_hist_matches_single_device():
+    """The production TPU configuration is the pallas histogram kernel
+    INSIDE shard_map with the data-axis psum — the v5p pod path. It must
+    compose (per-device kernel, XLA collective around it) and match the
+    single-device flat reference."""
+    import os
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(4096, 6).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float32)
+    d = DataMatrix(X, labels=y)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3}
+    old = os.environ.get("GRAFT_HIST_IMPL")
+    try:
+        os.environ["GRAFT_HIST_IMPL"] = "pallas"
+        mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+        f_mesh = train(dict(params), d, num_boost_round=3, mesh=mesh)
+        os.environ["GRAFT_HIST_IMPL"] = "flat"
+        f_flat = train(dict(params), d, num_boost_round=3)
+    finally:
+        if old is None:
+            os.environ.pop("GRAFT_HIST_IMPL", None)
+        else:
+            os.environ["GRAFT_HIST_IMPL"] = old
+    np.testing.assert_allclose(
+        np.asarray(f_mesh.predict(X)),
+        np.asarray(f_flat.predict(X)),
+        atol=2e-5,
+    )

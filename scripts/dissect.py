@@ -62,7 +62,8 @@ def main():
 
     rng = np.random.RandomState(0)
     n, d, B = N_ROWS, N_FEATURES, MAX_BIN + 1
-    bins = jnp.asarray(rng.randint(0, MAX_BIN, size=(n, d)).astype(np.int32))
+    bin_dtype = np.uint8 if B <= 256 else np.uint16  # match binning storage
+    bins = jnp.asarray(rng.randint(0, MAX_BIN, size=(n, d)).astype(bin_dtype))
     margins = jnp.asarray(rng.randn(n).astype(np.float32) * 0.3)
     labels = jnp.asarray((rng.rand(n) > 0.5).astype(np.float32))
     jax.block_until_ready((bins, margins, labels))

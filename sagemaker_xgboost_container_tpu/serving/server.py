@@ -68,6 +68,9 @@ def _load_user_hooks(model_dir):
     script = next((c for c in candidates if os.path.isfile(c)), None)
     if script is None:
         return {}
+    from ..utils.requirements import install_requirements_if_present
+
+    install_requirements_if_present(os.path.dirname(script))
     spec = importlib.util.spec_from_file_location("user_inference_module", script)
     module = importlib.util.module_from_spec(spec)
     sys.path.insert(0, os.path.dirname(script))

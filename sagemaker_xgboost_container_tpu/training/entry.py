@@ -82,6 +82,9 @@ def run_script_mode():
     script = os.path.join(code_dir, program)
     if not os.path.exists(script):
         raise exc.UserError("User entry point {} does not exist".format(script))
+    from ..utils.requirements import install_requirements_if_present
+
+    install_requirements_if_present(code_dir)
 
     # expose hyperparameters the way sagemaker-containers did
     env = dict(os.environ)

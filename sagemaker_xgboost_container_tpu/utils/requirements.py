@@ -1,0 +1,36 @@
+"""Best-effort install of a user module's requirements.txt.
+
+The reference installs a customer requirements.txt before loading
+script-mode code (mms_patch/model_server.py:158-166, hard-failing on pip
+errors) and the training toolkit does the same for training scripts. Same
+semantics here; shared by the training and serving script-mode loaders.
+"""
+
+import logging
+import os
+import subprocess
+import sys
+
+from ..toolkit import exceptions as exc
+
+logger = logging.getLogger(__name__)
+
+
+def install_requirements_if_present(code_dir):
+    """pip-install ``code_dir/requirements.txt`` when it exists.
+
+    Raises UserError on pip failure (customer-fixable: bad pins, no
+    network in the deployment environment, etc. — reference behavior)."""
+    path = os.path.join(code_dir, "requirements.txt")
+    if not os.path.isfile(path):
+        return False
+    logger.info("Installing packages from %s...", path)
+    cmd = [sys.executable, "-m", "pip", "install", "-r", path]
+    try:
+        subprocess.check_call(cmd)
+    except subprocess.CalledProcessError as e:
+        raise exc.UserError(
+            "Failed to install packages from the user module's "
+            "requirements.txt ({})".format(path)
+        ) from e
+    return True

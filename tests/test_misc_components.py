@@ -222,3 +222,24 @@ def test_batched_rounds_with_validation_set_device_metrics():
     np.testing.assert_allclose(
         batched["train"]["rmse"], plain["train"]["rmse"], rtol=1e-4, atol=1e-5
     )
+
+
+class TestRequirementsInstall:
+    def test_no_file_is_noop(self, tmp_path):
+        from sagemaker_xgboost_container_tpu.utils.requirements import (
+            install_requirements_if_present,
+        )
+
+        assert install_requirements_if_present(str(tmp_path)) is False
+
+    def test_bad_requirements_raises_user_error(self, tmp_path):
+        from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+        from sagemaker_xgboost_container_tpu.utils.requirements import (
+            install_requirements_if_present,
+        )
+
+        (tmp_path / "requirements.txt").write_text(
+            "this-package-definitely-does-not-exist-xyz==99.99.99\n"
+        )
+        with pytest.raises(exc.UserError):
+            install_requirements_if_present(str(tmp_path))

@@ -89,8 +89,8 @@ def _supervised_main():
                 dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_ROUTE_IMPL="onehot"),
             ),
             (
-                "pallas,totals=onehot",
-                dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_TOTALS_IMPL="onehot"),
+                "pallas,totals=pallas",
+                dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_TOTALS_IMPL="pallas"),
             ),
         ]
     note = "no probe succeeded"
@@ -131,7 +131,7 @@ def _supervised_main():
                 ("pallas,vnodes=0", "GRAFT_HIST_VNODES", "0"),
                 ("pallas,prec=bf16", "GRAFT_HIST_MM_PREC", "bf16"),
                 ("pallas,route=onehot", "GRAFT_ROUTE_IMPL", "onehot"),
-                ("pallas,totals=onehot", "GRAFT_TOTALS_IMPL", "onehot"),
+                ("pallas,totals=pallas", "GRAFT_TOTALS_IMPL", "pallas"),
             ]:
                 if results.get(label, 0.0) > base_v * 1.03:
                     composed[key] = val

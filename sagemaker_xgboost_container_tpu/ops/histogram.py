@@ -129,9 +129,11 @@ def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
     impl = os.environ.get("GRAFT_TOTALS_IMPL", "segment")
     if impl == "onehot":
         g_tot, h_tot = _totals_onehot(grad, hess, node_local, num_nodes)
+    elif impl == "pallas":
+        g_tot, h_tot = _totals_pallas(grad, hess, node_local, num_nodes)
     elif impl != "segment":
         raise ValueError(
-            "Unknown GRAFT_TOTALS_IMPL=%r; expected segment|onehot" % impl
+            "Unknown GRAFT_TOTALS_IMPL=%r; expected segment|onehot|pallas" % impl
         )
     else:
         active = node_local >= 0
@@ -189,6 +191,76 @@ def _totals_onehot(grad, hess, node_local, num_nodes):
     else:
         GH, _ = jax.lax.scan(body, init, jnp.arange(steps, dtype=jnp.int32))
     return GH[0], GH[1]
+
+
+@functools.lru_cache(maxsize=None)
+def _totals_pallas_fn(n, W, block, interpret):
+    """Pallas node-totals: per block, one-hot-scale (g|h) into [blk, 2W] and
+    row-sum into a VMEM [1, 2W] accumulator — pure VPU reduction, no sort
+    (segment_sum) and no matmul (the [2, c] @ [c, W] onehot dot pads M=2 to
+    a 128 tile). The last tree level runs this over every row."""
+    import jax.experimental.pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        vmem = pltpu.VMEM
+    except ImportError:  # pragma: no cover
+        vmem = None
+
+    def kernel(gh_ref, node_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        node = node_ref[:, 0]
+        onehot = (node[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (block, W), 1)).astype(jnp.float32)
+        g = gh_ref[:, 0]
+        h = gh_ref[:, 1]
+        A = jnp.concatenate([onehot * g[:, None], onehot * h[:, None]], axis=1)
+        out_ref[:] += jnp.sum(A, axis=0, keepdims=True)
+
+    steps = n // block
+    in_space = dict(memory_space=vmem) if vmem is not None and not interpret else {}
+    return pl.pallas_call(
+        kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((block, 2), lambda i: (i, 0), **in_space),
+            pl.BlockSpec((block, 1), lambda i: (i, 0), **in_space),
+        ],
+        out_specs=pl.BlockSpec((1, 2 * W), lambda i: (0, 0), **in_space),
+        out_shape=jax.ShapeDtypeStruct((1, 2 * W), jnp.float32),
+        interpret=interpret,
+    )
+
+
+def _totals_pallas(grad, hess, node_local, num_nodes):
+    n = grad.shape[0]
+    W = num_nodes
+    if n == 0:
+        z = jnp.zeros(W, jnp.float32)
+        return z, z
+    block = _pallas_block()
+    interpret = jax.default_backend() != "tpu"
+    active = node_local >= 0
+    g = jnp.where(active, grad, 0.0)
+    h = jnp.where(active, hess, 0.0)
+    node = jnp.where(active, node_local, jnp.int32(W))
+    n_pad = -(-n // block) * block
+    if n_pad != n:
+        pad = [(0, n_pad - n)]
+        g = jnp.pad(g, pad)
+        h = jnp.pad(h, pad)
+        node = jnp.pad(node, pad, constant_values=W)
+    gh = jnp.stack([g, h], axis=1)
+    out = _totals_pallas_fn(n_pad, W, block, interpret)(
+        gh, node[:, None].astype(jnp.int32)
+    )[0]
+    return out[:W], out[W:]
 
 
 # --------------------------------------------------------------------- flat

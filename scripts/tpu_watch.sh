@@ -29,7 +29,7 @@ run() {  # run <timeout> <logfile> <env...> -- cmd...
 
 run 1500 dissect_pallas.log GRAFT_HIST_IMPL=pallas python scripts/dissect.py
 run 1200 dissect_novnodes.log GRAFT_HIST_IMPL=pallas GRAFT_HIST_VNODES=0 python scripts/dissect.py
-run 1200 dissect_onehot.log GRAFT_HIST_IMPL=pallas GRAFT_ROUTE_IMPL=onehot GRAFT_TOTALS_IMPL=onehot python scripts/dissect.py
+run 1200 dissect_onehot.log GRAFT_HIST_IMPL=pallas GRAFT_ROUTE_IMPL=onehot GRAFT_TOTALS_IMPL=pallas python scripts/dissect.py
 run 900 bench_serve.log python bench_serve.py
 run 1500 bench_multiclass.log GRAFT_HIST_IMPL=pallas BENCH_TASK=multiclass python bench.py
 run 1500 bench_ranking.log GRAFT_HIST_IMPL=pallas BENCH_TASK=ranking python bench.py

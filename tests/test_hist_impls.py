@@ -339,9 +339,10 @@ def test_node_totals_onehot_matches_segment():
                 os.environ["GRAFT_TOTALS_IMPL"] = old
 
     g0, h0 = totals("segment")
-    g1, h1 = totals("onehot")
-    np.testing.assert_allclose(g1, g0, rtol=1e-4, atol=1e-3)
-    np.testing.assert_allclose(h1, h0, rtol=1e-4, atol=1e-3)
+    for impl in ("onehot", "pallas"):
+        g1, h1 = totals(impl)
+        np.testing.assert_allclose(g1, g0, rtol=1e-4, atol=1e-3, err_msg=impl)
+        np.testing.assert_allclose(h1, h0, rtol=1e-4, atol=1e-3, err_msg=impl)
 
 
 def test_vnode_packing_matches_flat():

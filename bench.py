@@ -140,12 +140,50 @@ def _supervised_main():
                 best_label, best_env = "+".join(parts), composed
     remaining = deadline - time.monotonic()
     if best_label is not None and remaining >= 10:
-        doc, err = _run_child(best_env, int(remaining))
+        # the composed config was never probed as a unit: cap its run so a
+        # bad interaction (bigger compile -> wedge) leaves time to retry
+        # with the best individually-measured config
+        composed_run = "+" in (best_label or "")
+        budget = int(remaining if not composed_run else max(60, remaining * 0.6))
+        doc, err = _run_child(best_env, budget)
         if doc:
             doc["metric"] = "{} [hist_impl={}]".format(doc["metric"], best_label)
             print(json.dumps(doc))
             return
         note = err or "benchmark timed out after {}s".format(BENCH_TIMEOUT_S)
+        if composed_run and results:
+            fallback_label = max(results, key=results.get)
+            fb_env = next(
+                (dict(env) for lbl, env in configs if lbl == fallback_label), {}
+            )
+            remaining = deadline - time.monotonic()
+            if remaining >= 30:
+                doc, err = _run_child(fb_env, int(remaining))
+                if doc:
+                    doc["metric"] = "{} [hist_impl={} after composed config failed]".format(
+                        doc["metric"], fallback_label
+                    )
+                    print(json.dumps(doc))
+                    return
+        if best_value > 0:
+            # full run died but the probes measured something real: report
+            # the best probe instead of a 0.0 (clearly labeled)
+            print(
+                json.dumps(
+                    {
+                        "metric": "boosting rounds/sec (synthetic, probe-only: "
+                        "full run failed: {}) [hist_impl={}]".format(
+                            note[:120], best_label
+                        ),
+                        "value": round(best_value, 3),
+                        "unit": "rounds/sec",
+                        "vs_baseline": round(
+                            best_value / NORTH_STAR_ROUNDS_PER_SEC, 3
+                        ),
+                    }
+                )
+            )
+            return
     elif best_label is not None:
         note = "benchmark timed out after {}s".format(BENCH_TIMEOUT_S)
     remaining = deadline - time.monotonic()

@@ -113,27 +113,40 @@ def _device_cut_points(features, w, max_cuts):
     import jax.numpy as jnp
 
     n, d = features.shape
+    # scatter buffers sized so distinct[:max_cuts] is well-defined even when
+    # the dataset has fewer rows than max_cuts (n=100, max_bin=256)
+    L = max(n, max_cuts)
 
     @jax.jit
     def kernel(cols, wv):
         def one(col):
             nanm = jnp.isnan(col)
+            # two-key sort: primary = value (NaN mapped to +inf), secondary =
+            # missing flag — so real +inf values (kept by the host path as
+            # ordinary distinct reps) sort strictly BEFORE the missing tail
+            # instead of interleaving with it
             key = jnp.where(nanm, jnp.inf, col)
-            sv, sw = jax.lax.sort_key_val(key, jnp.where(nanm, 0.0, wv))
-            valid = jnp.isfinite(sv)
+            sv, snan, sw = jax.lax.sort(
+                (key, nanm.astype(jnp.int32), jnp.where(nanm, 0.0, wv)),
+                num_keys=2,
+            )
+            valid = snan == 0
             cw = jnp.cumsum(sw)  # missing rows carry weight 0 at the tail
             nxt = jnp.concatenate([sv[1:], jnp.full((1,), jnp.inf, sv.dtype)])
-            is_end = valid & (sv != nxt)
+            nxt_invalid = jnp.concatenate(
+                [snan[1:] != 0, jnp.ones((1,), bool)]
+            )
+            is_end = valid & ((sv != nxt) | nxt_invalid)
             pos = jnp.cumsum(is_end.astype(jnp.int32)) - 1
             n_distinct = jnp.maximum(pos[-1] + 1, 0)
-            scatter_idx = jnp.where(is_end, pos, n)
+            scatter_idx = jnp.where(is_end, pos, L)
             distinct = (
-                jnp.full(n + 1, jnp.inf, sv.dtype)
-                .at[scatter_idx].set(sv, mode="drop")[:n]
+                jnp.full(L + 1, jnp.inf, sv.dtype)
+                .at[scatter_idx].set(sv, mode="drop")[:L]
             )
             cum_at = (
-                jnp.full(n + 1, jnp.inf, jnp.float32)
-                .at[scatter_idx].set(cw, mode="drop")[:n]
+                jnp.full(L + 1, jnp.inf, jnp.float32)
+                .at[scatter_idx].set(cw, mode="drop")[:L]
             )
             total = cw[-1]
             targets = total * (

@@ -368,7 +368,10 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         z = jnp.zeros((W, d, B), jnp.float32)
         return z, z
 
-    v = _vnode_factor(W, 1, d, B)  # chunk rows needn't divide v here
+    # chunk rows needn't divide v here (sub-group = row index mod v), so
+    # pass a block any power-of-two v divides — NOT 1, which would force
+    # the divisibility loop to grind v down to 1 and disable the packing
+    v = _vnode_factor(W, 128, d, B)
     Wv = W * v
     active = node_local >= 0
     g = jnp.where(active, grad, 0.0)

@@ -122,3 +122,25 @@ def test_device_apply_matches_host():
         else:
             assert b.dtype == host_bins.dtype
             np.testing.assert_array_equal(b, host_bins)
+
+
+def test_device_sketch_small_n_and_infinities():
+    """Regression (r2 review): (a) fewer rows than max_cuts must not crash
+    the static-shape select (100 rows at max_bin=256); (b) +inf feature
+    values are ordinary distinct reps on the host path and must be on the
+    device path too (NaN alone is the missing sentinel)."""
+    rng = np.random.RandomState(0)
+    Xs = rng.randn(100, 3).astype(np.float32)
+    for a, b in zip(_cuts(Xs, None, 256, "host"), _cuts(Xs, None, 256, "device")):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    Xi = np.array(
+        [[0.0], [1.0], [2.0], [np.inf], [np.inf], [np.nan], [1.0], [0.0]],
+        np.float32,
+    )
+    h = _cuts(Xi, None, 16, "host")[0]
+    d = _cuts(Xi, None, 16, "device")[0]
+    assert np.isinf(h[-1])  # host keeps the inf rep -> inf cut
+    assert h.shape == d.shape
+    np.testing.assert_allclose(h, d)

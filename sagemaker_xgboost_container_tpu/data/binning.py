@@ -102,12 +102,24 @@ def _sketch_impl():
 def _device_cut_points(features, w, max_cuts):
     """compute_cut_points's selection semantics as one vmapped XLA program.
 
-    Mirrors _select_cuts exactly: stable sort, cumulative weight at each
-    distinct value's run end, evenly spaced weighted-quantile targets,
-    left-searchsorted picks deduped, adjacent-rep midpoints; all-distinct
-    shortcut when a feature has <= max_cuts distinct values; one cut above
-    the value for single-valued columns; none for all-missing columns.
-    Static shapes: outputs padded to [d, max_cuts] + true counts.
+    Mirrors the _select_cuts ALGORITHM step for step: stable sort, cumulative
+    weight at each distinct value's run end, evenly spaced weighted-quantile
+    targets, left-searchsorted picks deduped, adjacent-rep midpoints;
+    all-distinct shortcut when a feature has <= max_cuts distinct values; one
+    cut above the value for single-valued columns; none for all-missing
+    columns. Static shapes: outputs padded to [d, max_cuts] + true counts.
+
+    NOT bitwise-identical to the host path: cumulative weights accumulate in
+    f32 via XLA's tree-structured scan and the quantile targets are f32,
+    while the host path does a sequential numpy f32 cumsum against f64
+    targets — on large n a razor-edge target can shift a searchsorted pick
+    by one distinct value, moving one cut by one value-midpoint (below
+    binning resolution; quality parity tested in tests/test_device_sketch.py).
+    A training job uses one lowering throughout (GRAFT_SKETCH_IMPL resolves
+    once per sketch), so within-job determinism is unaffected; retraining
+    with the other lowering may produce slightly different (equally valid)
+    cuts. TPU has no native f64, so exact host parity would need a
+    compensated scan — not worth it for a one-bin boundary shift.
     """
     import jax
     import jax.numpy as jnp

@@ -557,6 +557,11 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
     n, d = bins.shape
     W = num_nodes
     B = num_bins
+    if n == 0:
+        # grid would be (0,): the step-0 out_ref init never runs and the
+        # kernel would return an uninitialized buffer
+        zeros = jnp.zeros((W, d, B), jnp.float32)
+        return zeros, zeros
     block = _pallas_block()
     prec = _matmul_precision()
     interpret = jax.default_backend() != "tpu"

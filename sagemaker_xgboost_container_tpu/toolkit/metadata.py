@@ -67,8 +67,33 @@ def inference_spec(
     }
 
 
-def generate_algorithm_spec(image_uri):
-    """Full CreateAlgorithm document from the live schemas."""
+def fetch_instance_types(fetcher, default):
+    """The pricing-API gate: run the optional ``fetcher`` callable (the
+    network-era analog of reference metadata.py:18-40's boto3 Pricing query)
+    and fall back to the static registry when it is absent, fails, or
+    returns nothing — a zero-egress build must still emit a valid spec."""
+    if fetcher is None:
+        return list(default)
+    try:
+        fetched = list(fetcher() or [])
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "instance-type fetcher failed; using the static registry",
+            exc_info=True,
+        )
+        return list(default)
+    return fetched or list(default)
+
+
+def generate_algorithm_spec(image_uri, instance_type_fetcher=None):
+    """Full CreateAlgorithm document from the live schemas.
+
+    ``instance_type_fetcher``: optional zero-arg callable returning instance
+    type names (e.g. a boto3 Pricing API query where network exists); any
+    failure falls back to the static defaults.
+    """
     from ..algorithm import channels as cv
     from ..algorithm import hyperparameters as hpv
     from ..algorithm import metrics as metrics_mod
@@ -76,10 +101,16 @@ def generate_algorithm_spec(image_uri):
     metrics = metrics_mod.initialize()
     hps = hpv.initialize(metrics)
     channels = cv.initialize()
+    instances = fetch_instance_types(
+        instance_type_fetcher, DEFAULT_TRAINING_INSTANCES
+    )
     return {
-        "TrainingSpecification": training_spec(hps, channels, metrics, image_uri),
+        "TrainingSpecification": training_spec(
+            hps, channels, metrics, image_uri, supported_instance_types=instances
+        ),
         "InferenceSpecification": inference_spec(
             image_uri,
+            supported_instance_types=instances,
             supported_content_types=[
                 "text/csv",
                 "text/libsvm",

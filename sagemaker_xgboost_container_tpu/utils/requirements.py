@@ -3,34 +3,90 @@
 The reference installs a customer requirements.txt before loading
 script-mode code (mms_patch/model_server.py:158-166, hard-failing on pip
 errors) and the training toolkit does the same for training scripts. Same
-semantics here; shared by the training and serving script-mode loaders.
+semantics here, with one hardening on top of the reference: the install is
+constrained so a customer pin cannot silently downgrade the framework's own
+runtime (jax/numpy/...) underneath the live training job or model server.
+Shared by the training and serving script-mode loaders.
 """
 
 import logging
 import os
 import subprocess
 import sys
+import tempfile
 
 from ..toolkit import exceptions as exc
 
 logger = logging.getLogger(__name__)
 
+# packages the framework itself depends on at runtime: a user
+# requirements.txt may ADD packages freely but must not move these out from
+# under the running server (ADVICE r2)
+FRAMEWORK_CRITICAL = ("jax", "jaxlib", "libtpu", "numpy", "scipy", "pandas", "pyarrow")
+
+
+def _write_constraints_file():
+    """Pin the currently-installed versions of framework-critical packages
+    into a pip constraints file. Returns the path, or None if nothing is
+    pinnable (constraints only apply to packages the resolver touches, so
+    absent packages need no entry)."""
+    try:
+        import importlib.metadata as md
+    except ImportError:  # pragma: no cover - py<3.8
+        return None
+    pins = []
+    for pkg in FRAMEWORK_CRITICAL:
+        try:
+            pins.append("{}=={}".format(pkg, md.version(pkg)))
+        except md.PackageNotFoundError:
+            continue
+    if not pins:
+        return None
+    fd, path = tempfile.mkstemp(prefix="graft-constraints-", suffix=".txt")
+    with os.fdopen(fd, "w") as f:
+        f.write("\n".join(pins) + "\n")
+    return path
+
 
 def install_requirements_if_present(code_dir):
     """pip-install ``code_dir/requirements.txt`` when it exists.
 
-    Raises UserError on pip failure (customer-fixable: bad pins, no
-    network in the deployment environment, etc. — reference behavior)."""
+    The install runs under a constraints file pinning the framework's
+    critical dependencies at their current versions — a conflicting customer
+    pin fails loudly (UserError) instead of downgrading the live runtime.
+    Set GRAFT_PIP_NO_CONSTRAINTS=1 to opt out. Raises UserError on pip
+    failure (customer-fixable: bad pins, no network in the deployment
+    environment, etc. — reference behavior)."""
     path = os.path.join(code_dir, "requirements.txt")
     if not os.path.isfile(path):
         return False
     logger.info("Installing packages from %s...", path)
     cmd = [sys.executable, "-m", "pip", "install", "-r", path]
+    cpath = None
+    if os.environ.get("GRAFT_PIP_NO_CONSTRAINTS") != "1":
+        cpath = _write_constraints_file()
+        if cpath:
+            with open(cpath) as f:
+                logger.info(
+                    "Constraining framework-critical packages: %s",
+                    ", ".join(f.read().split()),
+                )
+            cmd += ["-c", cpath]
     try:
         subprocess.check_call(cmd)
     except subprocess.CalledProcessError as e:
         raise exc.UserError(
             "Failed to install packages from the user module's "
-            "requirements.txt ({})".format(path)
+            "requirements.txt ({}). If it pins a framework-critical package "
+            "({}) to an incompatible version, remove the pin or set "
+            "GRAFT_PIP_NO_CONSTRAINTS=1 to override at your own risk.".format(
+                path, ", ".join(FRAMEWORK_CRITICAL)
+            )
         ) from e
+    finally:
+        if cpath:
+            try:
+                os.unlink(cpath)
+            except OSError:
+                pass
     return True

@@ -382,6 +382,46 @@ def test_vnode_packing_matches_flat():
         np.testing.assert_allclose(H1, H0, atol=2e-4, err_msg=f"W={W}")
 
 
+@pytest.mark.parametrize("impl", ["flat", "per_feature", "matmul", "pallas"])
+def test_empty_input_yields_zero_histograms(impl):
+    """n==0 (empty shard / empty eval set) must return zeros from every
+    impl — the pallas grid would be (0,) and its step-0 out_ref init never
+    runs, so without an explicit guard it returns uninitialized VMEM
+    (ADVICE r2)."""
+    bins = jnp.zeros((0, 4), jnp.uint8)
+    grad = jnp.zeros((0,), jnp.float32)
+    hess = jnp.zeros((0,), jnp.float32)
+    node = jnp.zeros((0,), jnp.int32)
+    old = os.environ.get("GRAFT_HIST_IMPL")
+    try:
+        os.environ["GRAFT_HIST_IMPL"] = impl
+        G, H = hist_mod.level_histogram(bins, grad, hess, node, 4, 17)
+    finally:
+        if old is None:
+            os.environ.pop("GRAFT_HIST_IMPL", None)
+        else:
+            os.environ["GRAFT_HIST_IMPL"] = old
+    assert G.shape == (4, 4, 17) and H.shape == (4, 4, 17)
+    assert not np.asarray(G).any() and not np.asarray(H).any()
+
+
+@pytest.mark.parametrize("impl", ["segment", "onehot", "pallas"])
+def test_empty_input_yields_zero_totals(impl):
+    grad = jnp.zeros((0,), jnp.float32)
+    node = jnp.zeros((0,), jnp.int32)
+    old = os.environ.get("GRAFT_TOTALS_IMPL")
+    try:
+        os.environ["GRAFT_TOTALS_IMPL"] = impl
+        g, h = hist_mod.node_totals(grad, grad, node, 8)
+    finally:
+        if old is None:
+            os.environ.pop("GRAFT_TOTALS_IMPL", None)
+        else:
+            os.environ["GRAFT_TOTALS_IMPL"] = old
+    assert g.shape == (8,) and not np.asarray(g).any()
+    assert h.shape == (8,) and not np.asarray(h).any()
+
+
 def test_multiclass_vmap_over_pallas():
     """Multiclass training vmaps the tree builder over classes; the pallas
     histogram kernel must survive the vmap batching rule (bench BENCH_TASK=

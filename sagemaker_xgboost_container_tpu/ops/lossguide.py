@@ -76,6 +76,27 @@ def build_tree_lossguide(
     max_nodes = 2 * max_leaves - 1
     depth_cap = max_depth if max_depth > 0 else max_leaves
 
+    # colsample_bylevel: one Bernoulli feature mask per DEPTH, shared by all
+    # nodes at that depth (the leaf-wise analog of tree_build's per-level
+    # draw; same fold_in(rng, depth) stream so depthwise and lossguide agree
+    # on the sampling convention). Depths are traced here, so the masks are
+    # precomputed for every reachable depth and indexed dynamically.
+    level_masks = None
+    if colsample_bylevel < 1.0 and rng is not None:
+        draws = jax.vmap(
+            lambda i: jax.random.uniform(jax.random.fold_in(rng, i), (d,))
+        )(jnp.arange(depth_cap + 1))
+        level_masks = (draws < colsample_bylevel).astype(jnp.float32)
+
+    def _with_level_mask(mask, depth):
+        """Fold the depth's bylevel draw into a [d] or [2, d] mask."""
+        if level_masks is None:
+            return mask
+        lm = level_masks[jnp.minimum(depth, depth_cap)]
+        if mask is None:
+            return lm
+        return mask * lm if mask.ndim == 1 else mask * lm[None, :]
+
     tree = {
         "feature": jnp.zeros(max_nodes, jnp.int32),
         "bin": jnp.zeros(max_nodes, jnp.int32),
@@ -146,7 +167,9 @@ def build_tree_lossguide(
     root_splits = find_best_splits(
         G, H, num_cuts,
         reg_lambda=reg_lambda, alpha=alpha, gamma=gamma,
-        min_child_weight=min_child_weight, feature_mask=feature_mask, monotone=monotone,
+        min_child_weight=min_child_weight,
+        feature_mask=_with_level_mask(feature_mask, jnp.int32(0)),
+        monotone=monotone,
     )
     cand["gain"] = cand["gain"].at[0].set(root_splits["gain"][0])
     cand["feature"] = cand["feature"].at[0].set(root_splits["feature"][0])
@@ -205,6 +228,9 @@ def build_tree_lossguide(
             draw = jax.random.uniform(jax.random.fold_in(rng, 7919 + t), (2, d))
             sampled = (draw < colsample_bynode).astype(jnp.float32)
             node_mask = sampled if node_mask is None else sampled * node_mask[None, :]
+        # the children being scored sit at depth_ab: their candidate splits
+        # (executed at that depth) draw that depth's bylevel subset
+        node_mask = _with_level_mask(node_mask, depth_ab)
         GH = None
         if subtract:
             # histogram only the LEFT child; right = cached parent - left.

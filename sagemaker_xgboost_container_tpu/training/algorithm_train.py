@@ -198,6 +198,16 @@ def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
     Mirrors the reference's deterministic rank convention
     (distributed.py:155,:207). Gated to accelerator platforms: the CPU
     simulation tests drive the mesh path in-process instead.
+
+    Mid-train host loss: there is no worker-rejoin analog of the reference
+    tracker's ``recover`` path (dmlc_patch/tracker.py:341-353) — when a host
+    stops heartbeating, the coordination service poisons the collectives and
+    every surviving host terminates within ~GRAFT_HEARTBEAT_TIMEOUT_S
+    (default 100s; the job FAILS, it never continues on partial data).
+    Recovery is restart + checkpoint resume (training/checkpointing.py picks
+    up at the last saved round — the same story as the reference's spot
+    training). Failure semantics regression-tested in
+    tests/test_parallel.py::test_host_loss_aborts_survivors.
     """
     import jax
 
@@ -210,10 +220,22 @@ def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
         return False
     hosts = sorted(sm_hosts)
     try:
+        import inspect
+
+        kwargs = {}
+        # older jax (the >=0.4.30 contract floor) has no heartbeat kwarg;
+        # there the runtime's built-in default applies
+        if "heartbeat_timeout_seconds" in inspect.signature(
+            jax.distributed.initialize
+        ).parameters:
+            kwargs["heartbeat_timeout_seconds"] = int(
+                os.environ.get("GRAFT_HEARTBEAT_TIMEOUT_S", "100")
+            )
         jax.distributed.initialize(
             coordinator_address="{}:{}".format(hosts[0], port),
             num_processes=len(hosts),
             process_id=hosts.index(sm_current_host),
+            **kwargs,
         )
         logger.info(
             "jax.distributed up: %d processes, %d global devices",

@@ -341,6 +341,44 @@ def test_tree_method_binning_map():
     assert TrainConfig({}).max_bin == 256
 
 
+def test_approx_warns_and_matches_hist_quality(caplog):
+    """tree_method=approx is a surfaced deviation (VERDICT r2): it runs the
+    hist engine with ONE global sketch instead of libxgboost's per-iteration
+    re-sketch. Contract: (a) a warning is logged at config time so approx
+    users aren't silently retargeted; (b) model quality lands in the hist
+    band on a fixture (same candidate budget, different refresh)."""
+    import logging
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(9)
+    X = rng.rand(3000, 6).astype(np.float32)
+    y = (np.sin(5 * X[:, 0]) + X[:, 1] * X[:, 2] + 0.05 * rng.randn(3000)).astype(
+        np.float32
+    )
+
+    with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+        f_approx = train(
+            {"tree_method": "approx", "sketch_eps": 0.004, "max_depth": 4},
+            DataMatrix(X, labels=y),
+            num_boost_round=10,
+        )
+    assert any(
+        "approx" in r.message and "re-sketch" in r.message
+        for r in caplog.records
+    ), "approx deviation must be logged"
+
+    f_hist = train(
+        {"tree_method": "hist", "max_bin": 250, "max_depth": 4},
+        DataMatrix(X, labels=y),
+        num_boost_round=10,
+    )
+    rmse_a = float(np.sqrt(np.mean((np.asarray(f_approx.predict(X)) - y) ** 2)))
+    rmse_h = float(np.sqrt(np.mean((np.asarray(f_hist.predict(X)) - y) ** 2)))
+    assert abs(rmse_a - rmse_h) < 0.05 * max(rmse_h, 1e-6), (rmse_a, rmse_h)
+
+
 def test_exact_wins_over_stale_sketch_eps():
     """A leftover approx-only sketch_eps must not affect tree_method=exact."""
     from sagemaker_xgboost_container_tpu.models.booster import TrainConfig

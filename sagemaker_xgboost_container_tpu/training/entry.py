@@ -21,6 +21,7 @@ from .algorithm_train import sagemaker_train
 logger = logging.getLogger(__name__)
 
 FAILURE_FILE = "/opt/ml/output/failure"
+SM_INPUT_ROOT = "/opt/ml/input"
 
 
 def _read_json(path, default=None):
@@ -30,13 +31,66 @@ def _read_json(path, default=None):
     return default if default is not None else {}
 
 
+def derive_sm_env(input_root=SM_INPUT_ROOT):
+    """Fill missing SM_* env vars from the mounted /opt/ml tree.
+
+    A BYO SageMaker container receives only the filesystem contract —
+    /opt/ml/input/config/{hyperparameters,inputdataconfig,resourceconfig}.json
+    plus /opt/ml/input/data/<channel>/ mounts; the SM_* env variables are an
+    invention of the sagemaker-containers toolkit the reference embeds
+    (training.py:76-98 reads framework.training_env()). Same derivation
+    here, so ``docker run -v …:/opt/ml <image> train`` works bare.
+    Explicitly-set env always wins (tests/local runs override freely).
+    """
+    cfg = os.path.join(input_root, "config")
+    defaults = {
+        constants.SM_INPUT_TRAINING_CONFIG_FILE: os.path.join(
+            cfg, "hyperparameters.json"
+        ),
+        constants.SM_INPUT_DATA_CONFIG_FILE: os.path.join(
+            cfg, "inputdataconfig.json"
+        ),
+        constants.SM_CHECKPOINT_CONFIG_FILE: os.path.join(
+            cfg, "checkpointconfig.json"
+        ),
+        constants.SM_MODEL_DIR: "/opt/ml/model",
+        constants.SM_OUTPUT_DATA_DIR: "/opt/ml/output/data",
+    }
+    for key, path in defaults.items():
+        os.environ.setdefault(key, path)
+    data_root = os.path.join(input_root, "data")
+    if os.path.isdir(data_root):
+        for channel in sorted(os.listdir(data_root)):
+            channel_dir = os.path.join(data_root, channel)
+            if os.path.isdir(channel_dir):
+                os.environ.setdefault(
+                    "SM_CHANNEL_{}".format(channel.upper()), channel_dir
+                )
+    resource = _read_json(os.path.join(cfg, "resourceconfig.json"))
+    if resource:
+        os.environ.setdefault(
+            constants.SM_HOSTS, json.dumps(resource.get("hosts", ["algo-1"]))
+        )
+        os.environ.setdefault(
+            constants.SM_CURRENT_HOST, resource.get("current_host", "algo-1")
+        )
+    else:
+        os.environ.setdefault(constants.SM_HOSTS, '["algo-1"]')
+        os.environ.setdefault(constants.SM_CURRENT_HOST, "algo-1")
+
+
 def run_algorithm_mode():
     """Parse the SM env contract and run algorithm-mode training."""
     train_config = _read_json(os.getenv(constants.SM_INPUT_TRAINING_CONFIG_FILE))
     data_config = _read_json(os.getenv(constants.SM_INPUT_DATA_CONFIG_FILE))
     checkpoint_config = _read_json(os.getenv(constants.SM_CHECKPOINT_CONFIG_FILE))
 
-    train_path = os.environ[constants.SM_CHANNEL_TRAIN]
+    train_path = os.environ.get(constants.SM_CHANNEL_TRAIN)
+    if not train_path:
+        raise exc.UserError(
+            "No training data: the 'train' channel is required (mount it at "
+            "/opt/ml/input/data/train or set SM_CHANNEL_TRAIN)."
+        )
     val_path = os.environ.get(constants.SM_CHANNEL_VALIDATION)
     sm_hosts = json.loads(os.environ[constants.SM_HOSTS])
     sm_current_host = os.environ[constants.SM_CURRENT_HOST]
@@ -126,6 +180,7 @@ def _write_failure_file(message):
 def main():
     logging.basicConfig(level=logging.INFO)
     try:
+        derive_sm_env()
         train()
     except exc.BaseToolkitError as e:
         logger.exception("Training failed")

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Build the shipping image and run the SageMaker contract against it
+# end-to-end: fabricate the /opt/ml filesystem a training job receives,
+# `docker run … train` on abalone, assert the model artifact, then
+# `docker run … serve` and POST /invocations. This is the repo's analog of
+# the reference's local_mode harness (reference test/utils/local_mode.py:
+# 371-396 fabricates the same config tree; :477-557 runs the built image).
+#
+# Needs Docker (or podman via DOCKER=podman) + network for the pip installs
+# inside the build. CPU-only by default (JAX_SPEC=jax); pass
+# JAX_SPEC="jax[tpu]" to build the real TPU image.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+DOCKER="${DOCKER:-docker}"
+TAG="${IMAGE_TAG:-sagemaker-xgboost-tpu:smoke}"
+PORT="${SMOKE_PORT:-18081}"
+DATA_SRC="${ABALONE_DATA:-/root/reference/test/resources/abalone/data}"
+
+command -v "$DOCKER" >/dev/null || { echo "SKIP: $DOCKER not installed"; exit 75; }
+
+echo "== build =="
+"$DOCKER" build -f "$REPO/docker/Dockerfile.tpu" \
+  --build-arg JAX_SPEC="${JAX_SPEC:-jax}" -t "$TAG" "$REPO"
+
+WORK="$(mktemp -d)"
+CID=""
+trap '[ -n "$CID" ] && "$DOCKER" rm -f "$CID" >/dev/null 2>&1 || true; rm -rf "$WORK"' EXIT
+mkdir -p "$WORK"/{input/config,input/data/train,input/data/validation,model,output/data}
+
+cat > "$WORK/input/config/hyperparameters.json" <<'JSON'
+{"num_round": "10", "objective": "reg:squarederror", "max_depth": "4", "eval_metric": "rmse"}
+JSON
+cat > "$WORK/input/config/inputdataconfig.json" <<'JSON'
+{"train": {"ContentType": "libsvm", "TrainingInputMode": "File", "S3DistributionType": "FullyReplicated"},
+ "validation": {"ContentType": "libsvm", "TrainingInputMode": "File", "S3DistributionType": "FullyReplicated"}}
+JSON
+cat > "$WORK/input/config/resourceconfig.json" <<'JSON'
+{"current_host": "algo-1", "hosts": ["algo-1"]}
+JSON
+cp "$DATA_SRC"/train/* "$WORK/input/data/train/"
+cp "$DATA_SRC"/validation/* "$WORK/input/data/validation/"
+
+echo "== train (in-image) =="
+# only the /opt/ml mount + CMD "train": the image must derive the SM_* env
+# itself (sagemaker-containers parity — entry.derive_sm_env)
+"$DOCKER" run --rm -v "$WORK:/opt/ml" -e JAX_PLATFORMS=cpu "$TAG" train
+test -f "$WORK/model/xgboost-model" || { echo "FAIL: no model artifact"; exit 1; }
+
+echo "== serve (in-image) =="
+CID="$("$DOCKER" run -d -p "$PORT:8080" -v "$WORK/model:/opt/ml/model" \
+  -e JAX_PLATFORMS=cpu "$TAG" serve)"
+for i in $(seq 1 60); do
+  curl -sf "localhost:$PORT/ping" >/dev/null 2>&1 && break
+  sleep 1
+  [ "$i" = 60 ] && { echo "FAIL: serve never became healthy"; "$DOCKER" logs "$CID"; exit 1; }
+done
+PRED="$(curl -s -X POST "localhost:$PORT/invocations" -H "Content-Type: text/libsvm" \
+  -d "1:2 2:0.74 3:0.6 4:0.195 5:1.974 6:0.598 7:0.4085 8:0.71")"
+echo "prediction: $PRED"
+python3 - "$PRED" <<'EOF'
+import sys
+v = float(sys.argv[1].strip())
+assert 0.0 < v < 30.0, v  # abalone ring count band
+EOF
+echo "IMAGE SMOKE OK"

@@ -1,0 +1,34 @@
+#!/bin/bash
+# CI entrypoint for hosts without tox (e.g. the hermetic dev image).
+# Mirrors tox.ini's tiers:
+#   scripts/ci.sh fast   -> unit/contract tier (skips e2e + slow markers)
+#   scripts/ci.sh full   -> everything, with the coverage gate when
+#                           pytest-cov is installed (tox.ini gate: 60%)
+# Exits non-zero on any failure; prints a one-line verdict last.
+set -uo pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+TIER="${1:-fast}"
+cd "$REPO"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+COV_ARGS=()
+if [ "$TIER" = "full" ] && python -c "import pytest_cov" 2>/dev/null; then
+  COV_ARGS=(--cov=sagemaker_xgboost_container_tpu --cov-fail-under=60)
+fi
+
+case "$TIER" in
+  fast)
+    python -m pytest tests/ -q -x --ignore=tests/test_training_e2e.py \
+      -m "not slow and not e2e"
+    ;;
+  full)
+    python -m pytest tests/ -q "${COV_ARGS[@]}"
+    ;;
+  *)
+    echo "usage: $0 [fast|full]"; exit 2
+    ;;
+esac
+rc=$?
+[ $rc -eq 0 ] && echo "CI $TIER TIER OK" || echo "CI $TIER TIER FAILED (rc=$rc)"
+exit $rc

@@ -1,4 +1,5 @@
 import os
+import runpy
 
 from setuptools import Extension, find_packages, setup
 
@@ -6,6 +7,17 @@ from setuptools import Extension, find_packages, setup
 def read(fname):
     with open(os.path.join(os.path.dirname(__file__), fname)) as f:
         return f.read()
+
+
+# version contract loaded by path: the package itself (and its deps) may not
+# be importable yet at setup time
+_contract = runpy.run_path(
+    os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "sagemaker_xgboost_container_tpu",
+        "version_contract.py",
+    )
+)
 
 
 # The native data plane (libsvm tokenizer) ships as a compiled artifact in
@@ -37,15 +49,7 @@ setup(
     package_data={"sagemaker_xgboost_container_tpu.data": ["record_pb2.py"]},
     ext_modules=[fastdata_ext],
     python_requires=">=3.10",
-    install_requires=[
-        "jax",
-        "numpy",
-        "scipy",
-        "pandas",
-        "pyarrow",
-        "scikit-learn",
-        "protobuf",
-    ],
+    install_requires=_contract["install_requires"](),
     entry_points={
         "console_scripts": [
             # the container CMDs (reference setup.py:34-38)

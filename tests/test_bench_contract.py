@@ -145,6 +145,61 @@ def test_probe_matrix_emits_incremental_best(monkeypatch, capsys):
     assert all("best-so-far" in json.loads(l)["metric"] for l in out_lines)
 
 
+def test_supervised_winner_path_skips_probes(tmp_path, monkeypatch, capsys):
+    """With a persisted winner and a healthy backend, the supervisor must
+    run ONE full measurement with the winner env (no probe matrix) and
+    refresh the winner file from the result."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "WINNER_FILE", str(tmp_path / "w.json"))
+    bench._save_winner(
+        "pallas,prec=bf16",
+        {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16"},
+        3.5,
+        "seed",
+    )
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: True)
+    monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_REPROBE", raising=False)
+    calls = []
+
+    def fake_run_child(env_extra, timeout):
+        calls.append(dict(env_extra))
+        return {"metric": "m", "value": 4.2, "unit": "rounds/sec"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench._supervised_main()
+    assert len(calls) == 1  # winner only, no probes
+    assert calls[0]["GRAFT_HIST_MM_PREC"] == "bf16"
+    out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    doc = json.loads(out[-1])
+    assert "hist_impl=pallas,prec=bf16" in doc["metric"]
+    label, env = bench._load_winner()
+    assert label == "pallas,prec=bf16"  # refreshed, not clobbered
+    refreshed = json.load(open(str(tmp_path / "w.json")))
+    assert refreshed["value"] == 4.2 and refreshed["source"] == "full run"
+
+
+def test_supervised_wedged_precheck_goes_straight_to_cpu(monkeypatch, capsys):
+    """A failed backend pre-check must skip every TPU probe and produce the
+    labeled CPU fallback immediately."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: False)
+    monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+
+    def fake_run_child(env_extra, timeout):
+        calls.append(dict(env_extra))
+        return {"metric": "m", "value": 1.0, "unit": "rounds/sec"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench._supervised_main()
+    assert len(calls) == 1 and calls[0]["JAX_PLATFORMS"] == "cpu"
+    out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert "CPU FALLBACK" in json.loads(out[-1])["metric"]
+
+
 def test_committed_winner_file_is_valid():
     bench = _load_bench()
     label, env = bench._load_winner()

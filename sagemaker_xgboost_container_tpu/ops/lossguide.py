@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import level_histogram, subtraction_enabled
-from .split import find_best_splits, leaf_weight
+from .split import combine_splits_across_shards, find_best_splits, leaf_weight
 
 MIN_SPLIT_LOSS = 1e-6
 
@@ -68,13 +68,42 @@ def build_tree_lossguide(
         raise NotImplementedError(
             "interaction_constraints with grow_policy=lossguide is not supported yet"
         )
-    if feature_axis_name is not None:
-        raise NotImplementedError(
-            "feature-axis sharding with grow_policy=lossguide is not supported yet"
-        )
     n, d = bins.shape
     max_nodes = 2 * max_leaves - 1
     depth_cap = max_depth if max_depth > 0 else max_leaves
+
+    # feature-axis sharding: this shard holds columns [feat_shard*d,
+    # (feat_shard+1)*d) of the global matrix; candidate splits are combined
+    # across shards (combine_splits_across_shards) so the candidate store —
+    # and therefore every step's argmax — is identical on all shards, and
+    # feature ids in the store/tree are GLOBAL.
+    feat_shard = (
+        jax.lax.axis_index(feature_axis_name) if feature_axis_name is not None else None
+    )
+    # Column draws run over the REAL global feature count with the replicated
+    # rng (identical stream to the single-device build, which never pads);
+    # each shard then slices its own padded-column segment — the same
+    # convention as ops/tree_build, so depthwise and lossguide shards agree.
+    d_total = d * n_feature_shards
+    d_draw = int(d_global) if d_global is not None else d_total
+
+    def _pad_cols(mask_real):
+        if d_draw == d_total:
+            return mask_real
+        pad = [(0, 0)] * (mask_real.ndim - 1) + [(0, d_total - d_draw)]
+        return jnp.pad(mask_real, pad)
+
+    def _local_cols(mask_global):
+        if feature_axis_name is None:
+            return mask_global
+        start = (0,) * (mask_global.ndim - 1) + (feat_shard * d,)
+        sizes = mask_global.shape[:-1] + (d,)
+        return jax.lax.dynamic_slice(mask_global, start, sizes)
+
+    def _combine(splits):
+        if feature_axis_name is None:
+            return splits
+        return combine_splits_across_shards(splits, feat_shard, d, feature_axis_name)
 
     # colsample_bylevel: one Bernoulli feature mask per DEPTH, shared by all
     # nodes at that depth (the leaf-wise analog of tree_build's per-level
@@ -84,9 +113,11 @@ def build_tree_lossguide(
     level_masks = None
     if colsample_bylevel < 1.0 and rng is not None:
         draws = jax.vmap(
-            lambda i: jax.random.uniform(jax.random.fold_in(rng, i), (d,))
+            lambda i: jax.random.uniform(jax.random.fold_in(rng, i), (d_draw,))
         )(jnp.arange(depth_cap + 1))
-        level_masks = (draws < colsample_bylevel).astype(jnp.float32)
+        level_masks = _local_cols(
+            _pad_cols((draws < colsample_bylevel).astype(jnp.float32))
+        )
 
     def _with_level_mask(mask, depth):
         """Fold the depth's bylevel draw into a [d] or [2, d] mask."""

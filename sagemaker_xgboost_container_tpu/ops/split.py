@@ -12,9 +12,48 @@ missing); the scan considers splits at bins 0..B-3 masked by each feature's
 true cut count.
 """
 
+import jax
 import jax.numpy as jnp
 
 _EPS = 1e-6  # xgboost kRtEps: minimum loss change to accept a split
+
+
+def combine_splits_across_shards(splits, feat_shard, d_local, feature_axis_name):
+    """Merge per-shard best splits along a *feature* mesh axis.
+
+    Each column shard proposes its best (gain, local feature, bin,
+    default_left) per node; the winner is the max gain with ties broken
+    toward the lowest global feature id (matching the single-device argmax
+    over the concatenated column order), and the winning shard's bin /
+    default_left are psum-broadcast so every shard ends with identical
+    global split decisions. ``g_total``/``h_total`` are already identical
+    on every shard (every row lands in exactly one bin of every feature).
+
+    Used by both the depthwise (ops/tree_build.py) and leaf-wise
+    (ops/lossguide.py) builders — the reference's vestigial dsplit=col
+    (hyperparameter_validation.py:256) done as SPMD.
+    """
+    global_feat = splits["feature"] + feat_shard * d_local
+    gain = splits["gain"]
+    best_gain = jax.lax.pmax(gain, feature_axis_name)
+    is_tied_winner = gain == best_gain
+    cand = jnp.where(is_tied_winner, global_feat, jnp.int32(2**30))
+    win_feat = jax.lax.pmin(cand, feature_axis_name)
+    i_own = is_tied_winner & (global_feat == win_feat)
+
+    def _sel(x):
+        return jax.lax.psum(
+            jnp.where(i_own, x, jnp.zeros_like(x)), feature_axis_name
+        )
+
+    return {
+        "gain": best_gain,
+        "feature": _sel(global_feat),
+        "bin": _sel(splits["bin"]),
+        "default_left": _sel(splits["default_left"].astype(jnp.int32)) > 0,
+        "g_total": splits["g_total"],
+        "h_total": splits["h_total"],
+    }
 
 
 def _threshold_l1(g, alpha):

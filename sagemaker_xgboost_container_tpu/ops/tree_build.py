@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import level_histogram, node_totals, subtraction_enabled
-from .split import find_best_splits, leaf_weight
+from .split import combine_splits_across_shards, find_best_splits, leaf_weight
 
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
 
@@ -247,30 +247,9 @@ def build_tree(
             monotone=monotone,
         )
         if feature_axis_name is not None:
-            # combine candidates across the column shards: winner = max gain,
-            # ties broken toward the lowest global feature id; every shard
-            # ends with identical (global-feature) split decisions
-            global_feat = splits["feature"] + feat_shard * d
-            gain = splits["gain"]
-            best_gain = jax.lax.pmax(gain, feature_axis_name)
-            is_tied_winner = gain == best_gain
-            cand = jnp.where(is_tied_winner, global_feat, jnp.int32(2**30))
-            win_feat = jax.lax.pmin(cand, feature_axis_name)
-            i_own = is_tied_winner & (global_feat == win_feat)
-
-            def _combine(x):
-                return jax.lax.psum(
-                    jnp.where(i_own, x, jnp.zeros_like(x)), feature_axis_name
-                )
-
-            splits = {
-                "gain": best_gain,
-                "feature": _combine(global_feat),
-                "bin": _combine(splits["bin"]),
-                "default_left": _combine(splits["default_left"].astype(jnp.int32)) > 0,
-                "g_total": splits["g_total"],   # identical on every shard
-                "h_total": splits["h_total"],
-            }
+            splits = combine_splits_across_shards(
+                splits, feat_shard, d, feature_axis_name
+            )
 
         g_tot, h_tot = splits["g_total"], splits["h_total"]
         weight = leaf_weight(

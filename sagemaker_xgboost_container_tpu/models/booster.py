@@ -272,10 +272,6 @@ class _TrainingSession:
                     "the mesh with the data axis across hosts and the "
                     "feature axis over each host's local devices."
                 )
-        if self.has_feature_axis and config.grow_policy == "lossguide":
-            raise exc.UserError(
-                "feature-axis sharding does not support lossguide growth yet"
-            )
         if self.is_multiprocess:
             # local rows pad to a multiple of the *local* data shards; the
             # global array is the concatenation over processes

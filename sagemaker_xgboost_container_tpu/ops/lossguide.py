@@ -21,7 +21,12 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import level_histogram, subtraction_enabled
-from .split import combine_splits_across_shards, find_best_splits, leaf_weight
+from .split import (
+    column_shard_helpers,
+    combine_splits_across_shards,
+    find_best_splits,
+    leaf_weight,
+)
 
 MIN_SPLIT_LOSS = 1e-6
 
@@ -64,10 +69,6 @@ def build_tree_lossguide(
     Same output layout as ops.tree_build.build_tree; max_depth=0 means
     unbounded depth (bounded by max_leaves - 1).
     """
-    if interaction_sets is not None:
-        raise NotImplementedError(
-            "interaction_constraints with grow_policy=lossguide is not supported yet"
-        )
     n, d = bins.shape
     max_nodes = 2 * max_leaves - 1
     depth_cap = max_depth if max_depth > 0 else max_leaves
@@ -80,25 +81,11 @@ def build_tree_lossguide(
     feat_shard = (
         jax.lax.axis_index(feature_axis_name) if feature_axis_name is not None else None
     )
-    # Column draws run over the REAL global feature count with the replicated
-    # rng (identical stream to the single-device build, which never pads);
-    # each shard then slices its own padded-column segment — the same
-    # convention as ops/tree_build, so depthwise and lossguide shards agree.
-    d_total = d * n_feature_shards
-    d_draw = int(d_global) if d_global is not None else d_total
-
-    def _pad_cols(mask_real):
-        if d_draw == d_total:
-            return mask_real
-        pad = [(0, 0)] * (mask_real.ndim - 1) + [(0, d_total - d_draw)]
-        return jnp.pad(mask_real, pad)
-
-    def _local_cols(mask_global):
-        if feature_axis_name is None:
-            return mask_global
-        start = (0,) * (mask_global.ndim - 1) + (feat_shard * d,)
-        sizes = mask_global.shape[:-1] + (d,)
-        return jax.lax.dynamic_slice(mask_global, start, sizes)
+    # shared column-draw convention (ops/split.py), so depthwise and
+    # lossguide shards agree on every mask stream
+    d_draw, _pad_cols, _local_cols = column_shard_helpers(
+        feat_shard, d, n_feature_shards, d_global
+    )
 
     def _combine(splits):
         if feature_axis_name is None:
@@ -151,6 +138,24 @@ def build_tree_lossguide(
     node_h = jnp.zeros(max_nodes, jnp.float32)
     node_depth = jnp.zeros(max_nodes, jnp.int32)
 
+    # interaction constraints: per-node alive constraint sets, the leaf-wise
+    # form of tree_build's level-synchronous update. A feature is usable in a
+    # node iff some still-alive set contains it; splitting on f keeps alive
+    # only the sets containing f (xgboost semantics). ``interaction_sets``
+    # spans GLOBAL columns; per-node masks are sliced to this shard's segment.
+    alive_sets = None
+    if interaction_sets is not None:
+        num_sets = interaction_sets.shape[0]
+        alive_sets = jnp.zeros((max_nodes, num_sets), jnp.bool_)
+        alive_sets = alive_sets.at[0].set(True)
+
+    def _allowed_cols(alive_row):
+        """[S] alive-set row -> local [d] allowed-feature mask (f32)."""
+        allowed = (
+            alive_row.astype(jnp.float32) @ interaction_sets.astype(jnp.float32)
+        ) > 0
+        return _local_cols(allowed.astype(jnp.float32))
+
     node_of_row = jnp.zeros(n, jnp.int32)
 
     def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab, mask=None, GH=None):
@@ -178,6 +183,9 @@ def build_tree_lossguide(
             feature_mask=mask if mask is not None else feature_mask,
             monotone=monotone,
         )
+        # cross-shard combine: the candidate store (and therefore every
+        # step's argmax) must be identical on all shards, with GLOBAL ids
+        splits = _combine(splits)
         # depth cap: children at depth_cap can never split
         can_deepen = depth_ab < depth_cap
         gains = jnp.where(can_deepen, splits["gain"], -jnp.inf)
@@ -195,13 +203,18 @@ def build_tree_lossguide(
     if subtract:
         hist_G = hist_G.at[0].set(G[0])
         hist_H = hist_H.at[0].set(H[0])
+    root_mask = _with_level_mask(feature_mask, jnp.int32(0))
+    if alive_sets is not None:
+        allowed0 = _allowed_cols(alive_sets[0])
+        root_mask = allowed0 if root_mask is None else root_mask * allowed0
     root_splits = find_best_splits(
         G, H, num_cuts,
         reg_lambda=reg_lambda, alpha=alpha, gamma=gamma,
         min_child_weight=min_child_weight,
-        feature_mask=_with_level_mask(feature_mask, jnp.int32(0)),
+        feature_mask=root_mask,
         monotone=monotone,
     )
+    root_splits = _combine(root_splits)
     cand["gain"] = cand["gain"].at[0].set(root_splits["gain"][0])
     cand["feature"] = cand["feature"].at[0].set(root_splits["feature"][0])
     cand["bin"] = cand["bin"].at[0].set(root_splits["bin"][0])
@@ -239,9 +252,26 @@ def build_tree_lossguide(
         in_l = node_of_row == l
         # one scalar feature for every row: a dynamic column slice, not a
         # per-row gather
-        row_bin = jax.lax.dynamic_slice(bins, (0, f_l), (n, 1))[:, 0]
-        is_missing = row_bin == (num_bins - 1)
-        go_right = jnp.where(is_missing, ~dl_l, row_bin > b_l)
+        if feature_axis_name is None:
+            row_bin = jax.lax.dynamic_slice(bins, (0, f_l), (n, 1))[:, 0]
+            is_missing = row_bin == (num_bins - 1)
+            go_right = jnp.where(is_missing, ~dl_l, row_bin > b_l)
+        else:
+            # only the shard owning the winning (global) feature can decide
+            # the rows; decisions psum-broadcast along the feature axis —
+            # same convention as tree_build's level routing
+            owner = (f_l // d) == feat_shard
+            f_local = jnp.clip(f_l - feat_shard * d, 0, d - 1)
+            row_bin = jax.lax.dynamic_slice(bins, (0, f_local), (n, 1))[:, 0]
+            is_missing = row_bin == (num_bins - 1)
+            decision = jnp.where(is_missing, ~dl_l, row_bin > b_l)
+            go_right = (
+                jax.lax.psum(
+                    jnp.where(owner, decision, False).astype(jnp.int32),
+                    feature_axis_name,
+                )
+                > 0
+            )
         new_node = jnp.where(go_right, id_b, id_a)
         node_of_row = jnp.where(in_l & can, new_node, node_of_row)
 
@@ -256,12 +286,29 @@ def build_tree_lossguide(
         )
         node_mask = feature_mask
         if colsample_bynode < 1.0 and rng is not None:
-            draw = jax.random.uniform(jax.random.fold_in(rng, 7919 + t), (2, d))
-            sampled = (draw < colsample_bynode).astype(jnp.float32)
+            # drawn over GLOBAL columns (identical stream to single-device),
+            # each shard slicing its own segment — see the bylevel comment
+            draw = jax.random.uniform(jax.random.fold_in(rng, 7919 + t), (2, d_draw))
+            sampled = _local_cols(
+                _pad_cols((draw < colsample_bynode).astype(jnp.float32))
+            )
             node_mask = sampled if node_mask is None else sampled * node_mask[None, :]
         # the children being scored sit at depth_ab: their candidate splits
         # (executed at that depth) draw that depth's bylevel subset
         node_mask = _with_level_mask(node_mask, depth_ab)
+        if alive_sets is not None:
+            # both fresh children inherit alive-sets = parent's ∩ {sets
+            # containing the split feature}; inert when the step can't split
+            # (their candidate gains are forced to -inf below)
+            child_alive = alive_sets[l] & interaction_sets[:, f_l]
+            alive_sets = alive_sets.at[id_a].set(child_alive).at[id_b].set(child_alive)
+            allowed = _allowed_cols(child_alive)
+            if node_mask is None:
+                node_mask = allowed
+            elif node_mask.ndim == 1:
+                node_mask = node_mask * allowed
+            else:
+                node_mask = node_mask * allowed[None, :]
         GH = None
         if subtract:
             # histogram only the LEFT child; right = cached parent - left.

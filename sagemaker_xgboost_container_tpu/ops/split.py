@@ -56,6 +56,40 @@ def combine_splits_across_shards(splits, feat_shard, d_local, feature_axis_name)
     }
 
 
+def column_shard_helpers(feat_shard, d_local, n_feature_shards, d_global):
+    """Shared cross-shard column-draw convention for both tree builders.
+
+    Column-subset draws (colsample_bylevel/bynode, interaction masks) are
+    made over the REAL global feature count ``d_draw`` with the replicated
+    rng — an identical threefry stream to the single-device build, which
+    never pads — then zero-padded to the padded global width and sliced to
+    this shard's segment. A per-shard draw would silently decorrelate split
+    choices across shards.
+
+    Returns ``(d_draw, pad_cols, local_cols)`` where ``pad_cols`` zero-pads
+    a [..., d_draw] mask to [..., d_total] and ``local_cols`` slices a
+    global-width mask down to this shard's [..., d_local] columns (identity
+    when there is no feature axis, i.e. ``feat_shard is None``).
+    """
+    d_total = d_local * n_feature_shards
+    d_draw = int(d_global) if d_global is not None else d_total
+
+    def pad_cols(mask_real):
+        if d_draw == d_total:
+            return mask_real
+        pad = [(0, 0)] * (mask_real.ndim - 1) + [(0, d_total - d_draw)]
+        return jnp.pad(mask_real, pad)
+
+    def local_cols(mask_global):
+        if feat_shard is None:
+            return mask_global
+        start = (0,) * (mask_global.ndim - 1) + (feat_shard * d_local,)
+        sizes = mask_global.shape[:-1] + (d_local,)
+        return jax.lax.dynamic_slice(mask_global, start, sizes)
+
+    return d_draw, pad_cols, local_cols
+
+
 def _threshold_l1(g, alpha):
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
 

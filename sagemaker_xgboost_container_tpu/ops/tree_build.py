@@ -19,7 +19,12 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import level_histogram, node_totals, subtraction_enabled
-from .split import combine_splits_across_shards, find_best_splits, leaf_weight
+from .split import (
+    column_shard_helpers,
+    combine_splits_across_shards,
+    find_best_splits,
+    leaf_weight,
+)
 
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
 
@@ -184,27 +189,11 @@ def build_tree(
             )
         if subtract:
             G_cache, H_cache = G, H
-        # Column draws are made over the REAL global feature count with the
-        # replicated rng (identical on every shard — and an identical
-        # threefry stream to the single-device build, which never pads), the
-        # mask is zero-padded to the padded global width, and each shard
-        # slices its own segment. A per-shard draw would silently
-        # decorrelate split choices across shards.
-        d_total = d * n_feature_shards
-        d_draw = int(d_global) if d_global is not None else d_total
-
-        def _pad_cols(mask_real):
-            if d_draw == d_total:
-                return mask_real
-            pad = [(0, 0)] * (mask_real.ndim - 1) + [(0, d_total - d_draw)]
-            return jnp.pad(mask_real, pad)
-
-        def _local_cols(mask_global):
-            if feature_axis_name is None:
-                return mask_global
-            start = (0,) * (mask_global.ndim - 1) + (feat_shard * d,)
-            sizes = mask_global.shape[:-1] + (d,)
-            return jax.lax.dynamic_slice(mask_global, start, sizes)
+        # shared column-draw convention (ops/split.py): draws over the REAL
+        # global feature count, padded then sliced per shard
+        d_draw, _pad_cols, _local_cols = column_shard_helpers(
+            feat_shard, d, n_feature_shards, d_global
+        )
 
         level_mask = feature_mask
         if colsample_bylevel < 1.0 and rng is not None:

@@ -37,9 +37,21 @@ def _write_constraints_file():
     pins = []
     for pkg in FRAMEWORK_CRITICAL:
         try:
-            pins.append("{}=={}".format(pkg, md.version(pkg)))
+            ver = md.version(pkg)
         except md.PackageNotFoundError:
             continue
+        # PEP 440 local labels ('0.4.30+tpu...') name builds pip cannot
+        # resolve against an index, so an exact pin would fail every user
+        # install (ADVICE r3) — while pinning the *public* version would let
+        # pip silently swap the platform build for the index wheel. Neither
+        # is right: skip the pin and leave that package unguarded.
+        if "+" in ver:
+            logger.info(
+                "Not constraining %s==%s (local build label; pip cannot "
+                "resolve it against an index)", pkg, ver,
+            )
+            continue
+        pins.append("{}=={}".format(pkg, ver))
     if not pins:
         return None
     fd, path = tempfile.mkstemp(prefix="graft-constraints-", suffix=".txt")

@@ -79,26 +79,58 @@ def test_winner_file_roundtrip(tmp_path, monkeypatch):
         "NOT_A_CONFIG_KEY": "x",
     }
     bench._save_winner("pallas,prec=bf16", env, 3.499, "test")
-    label, loaded = bench._load_winner()
+    label, loaded, stale = bench._load_winner()
     assert label == "pallas,prec=bf16"
     assert loaded["GRAFT_HIST_IMPL"] == "pallas"
     assert loaded["GRAFT_HIST_MM_PREC"] == "bf16"
     assert "NOT_A_CONFIG_KEY" not in loaded
+    # saved and loaded at the same code revision (or undecidable) -> fresh
+    assert stale is False
+
+
+def test_winner_stale_when_code_changed(tmp_path, monkeypatch):
+    """A winner measured under a different perf-code fingerprint (or with
+    no stamp at all — e.g. the r2-era file) must come back stale so the
+    supervisor re-probes instead of measuring a stale config (VERDICT r3
+    weak #3)."""
+    bench = _load_bench()
+    assert bench._code_fingerprint(), "perf sources must be hashable in-repo"
+    w = tmp_path / "w.json"
+    monkeypatch.setattr(bench, "WINNER_FILE", str(w))
+    w.write_text(
+        json.dumps(
+            {
+                "label": "pallas",
+                "env": {"GRAFT_HIST_IMPL": "pallas"},
+                "value": 3.5,
+                "code": "000000000000",
+            }
+        )
+    )
+    _, _, stale = bench._load_winner()
+    assert stale is True
+    w.write_text(
+        json.dumps(
+            {"label": "pallas", "env": {"GRAFT_HIST_IMPL": "pallas"}, "value": 3.5}
+        )
+    )
+    _, _, stale = bench._load_winner()
+    assert stale is True
 
 
 def test_winner_file_missing_or_corrupt(tmp_path, monkeypatch):
     bench = _load_bench()
     monkeypatch.setattr(bench, "WINNER_FILE", str(tmp_path / "absent.json"))
-    assert bench._load_winner() == (None, None)
+    assert bench._load_winner() == (None, None, False)
     bad = tmp_path / "bad.json"
     bad.write_text("{not json")
     monkeypatch.setattr(bench, "WINNER_FILE", str(bad))
-    assert bench._load_winner() == (None, None)
+    assert bench._load_winner() == (None, None, False)
     # env without GRAFT_HIST_IMPL is rejected (e.g. saved from a pinned run)
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"label": "x", "env": {}}))
     monkeypatch.setattr(bench, "WINNER_FILE", str(empty))
-    assert bench._load_winner() == (None, None)
+    assert bench._load_winner() == (None, None, False)
 
 
 def test_probe_circuit_breaker_stops_after_two_timeouts(monkeypatch):
@@ -174,10 +206,74 @@ def test_supervised_winner_path_skips_probes(tmp_path, monkeypatch, capsys):
     out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
     doc = json.loads(out[-1])
     assert "hist_impl=pallas,prec=bf16" in doc["metric"]
-    label, env = bench._load_winner()
+    label, env, _stale = bench._load_winner()
     assert label == "pallas,prec=bf16"  # refreshed, not clobbered
     refreshed = json.load(open(str(tmp_path / "w.json")))
     assert refreshed["value"] == 4.2 and refreshed["source"] == "full run"
+
+
+def test_supervised_stale_winner_reprobes(tmp_path, monkeypatch, capsys):
+    """A stale persisted winner (older perf-code fingerprint) must trigger
+    the full probe matrix instead of a single winner measurement."""
+    bench = _load_bench()
+    w = tmp_path / "w.json"
+    monkeypatch.setattr(bench, "WINNER_FILE", str(w))
+    w.write_text(
+        json.dumps(
+            {
+                "label": "pallas,prec=bf16",
+                "env": {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16"},
+                "value": 3.5,
+                "code": "000000000000",
+            }
+        )
+    )
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: True)
+    monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_REPROBE", raising=False)
+    calls = []
+
+    def fake_run_child(env_extra, timeout):
+        calls.append(dict(env_extra))
+        return {"metric": "m", "value": 3.0, "unit": "rounds/sec"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench._supervised_main()
+    assert len(calls) > 2  # probe matrix ran, not just the winner config
+
+
+def test_supervised_failed_winner_reprobes(tmp_path, monkeypatch, capsys):
+    """ADVICE r3: when the (fresh) persisted winner's full run fails, the
+    supervisor must re-probe the matrix with the remaining budget rather
+    than dumping straight to the CPU fallback."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "WINNER_FILE", str(tmp_path / "w.json"))
+    bench._save_winner(
+        "pallas,prec=bf16",
+        {"GRAFT_HIST_IMPL": "pallas", "GRAFT_HIST_MM_PREC": "bf16"},
+        3.5,
+        "seed",
+    )
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: True)
+    monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_REPROBE", raising=False)
+    calls = []
+
+    def fake_run_child(env_extra, timeout):
+        calls.append(dict(env_extra))
+        if len(calls) == 1:  # the persisted-winner full run wedges
+            return None, "child timed out"
+        return {"metric": "m", "value": 2.5, "unit": "rounds/sec"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench._supervised_main()
+    assert len(calls) > 2, "probe matrix must run after the winner failed"
+    out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    doc = json.loads(out[-1])
+    assert "CPU FALLBACK" not in doc["metric"]
+    assert doc["value"] == 2.5
 
 
 def test_supervised_wedged_precheck_goes_straight_to_cpu(monkeypatch, capsys):
@@ -202,6 +298,6 @@ def test_supervised_wedged_precheck_goes_straight_to_cpu(monkeypatch, capsys):
 
 def test_committed_winner_file_is_valid():
     bench = _load_bench()
-    label, env = bench._load_winner()
+    label, env, _stale = bench._load_winner()
     assert label is not None, "bench_winner.json must stay loadable"
     assert env["GRAFT_HIST_IMPL"] in {"flat", "matmul", "pallas", "per_feature"}

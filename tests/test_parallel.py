@@ -235,6 +235,107 @@ def test_lossguide_colsample_bylevel():
     assert rmse < 0.35 * base
 
 
+def _paths_within_sets(tree, sets):
+    """Walk root->leaf; every path's split features must fit one set."""
+    stack = [(0, frozenset())]
+    while stack:
+        node, used = stack.pop()
+        if tree.left[node] < 0:
+            if used and not any(used <= s for s in sets):
+                return False
+            continue
+        used2 = used | {int(tree.feature[node])}
+        stack.append((int(tree.left[node]), used2))
+        stack.append((int(tree.right[node]), used2))
+    return True
+
+
+@pytest.mark.multichip
+def test_lossguide_2d_mesh_matches_single_device():
+    """r3 parity lift (ADVICE medium + VERDICT #4): lossguide growth on a
+    (data x feature) mesh — candidate-store combine across column shards +
+    owner/psum row routing — must reproduce the single-device trees, with
+    and without colsample draws."""
+    from jax.sharding import Mesh as JMesh
+
+    X, y = _friedman(768)  # d = 5 pads to 6 across 2 feature shards
+    dtrain = DataMatrix(X, labels=y)
+    params = {
+        "grow_policy": "lossguide",
+        "max_leaves": 12,
+        "max_depth": 0,
+        "eta": 0.3,
+        "seed": 7,
+    }
+    single = train(dict(params), dtrain, num_boost_round=5)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = JMesh(devices, axis_names=("data", "feature"))
+    sharded = train(dict(params), dtrain, num_boost_round=5, mesh=mesh2d)
+    np.testing.assert_allclose(
+        single.predict(X), sharded.predict(X), rtol=1e-4, atol=1e-4
+    )
+    # colsample draws ride the replicated global rng stream: identical trees
+    p2 = dict(params, colsample_bylevel=0.6, colsample_bynode=0.8, seed=9)
+    single2 = train(dict(p2), dtrain, num_boost_round=4)
+    sharded2 = train(dict(p2), dtrain, num_boost_round=4, mesh=mesh2d)
+    np.testing.assert_allclose(
+        single2.predict(X), sharded2.predict(X), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_interaction_constraints_lossguide():
+    """r3 parity lift (VERDICT #4): interaction_constraints x lossguide —
+    per-leaf alive constraint sets thread through best-first growth; no
+    root->leaf path may mix features across sets, and the model still
+    learns the learnable part of the signal."""
+    rng = np.random.RandomState(11)
+    X = rng.rand(1500, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 2] * 10).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {
+            "grow_policy": "lossguide",
+            "max_leaves": 12,
+            "max_depth": 0,
+            "interaction_constraints": [[0, 1], [2, 3]],
+        },
+        dtrain,
+        num_boost_round=8,
+    )
+    sets = [{0, 1}, {2, 3}]
+    assert all(_paths_within_sets(t, sets) for t in forest.trees)
+    # splits must actually have happened (constraints didn't kill growth)
+    assert any((~t.is_leaf).any() for t in forest.trees)
+
+
+@pytest.mark.multichip
+def test_interaction_constraints_lossguide_2d_mesh():
+    """Constraint masks are sliced per column shard: the sharded lossguide
+    build must agree with single-device under interaction_constraints."""
+    from jax.sharding import Mesh as JMesh
+
+    rng = np.random.RandomState(17)
+    X = rng.rand(1024, 5).astype(np.float32)
+    y = (X[:, 0] * X[:, 2] * 10 + X[:, 4]).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    params = {
+        "grow_policy": "lossguide",
+        "max_leaves": 10,
+        "max_depth": 0,
+        "interaction_constraints": [[0, 1], [2, 3], [4]],
+        "seed": 3,
+    }
+    single = train(dict(params), dtrain, num_boost_round=4)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = JMesh(devices, axis_names=("data", "feature"))
+    sharded = train(dict(params), dtrain, num_boost_round=4, mesh=mesh2d)
+    np.testing.assert_allclose(
+        single.predict(X), sharded.predict(X), rtol=1e-4, atol=1e-4
+    )
+    sets = [{0, 1}, {2, 3}, {4}]
+    assert all(_paths_within_sets(t, sets) for t in sharded.trees)
+
+
 def test_colsample_bylevel_still_learns():
     X, y = _friedman(800)
     dtrain = DataMatrix(X, labels=y)

@@ -153,11 +153,21 @@ def host_loss_worker(rank, world, port, q):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # older jax (the >=0.4.30 contract floor) has no heartbeat kwarg — gate
+    # it exactly as the production path does (algorithm_train.py); without
+    # it the runtime default applies and the test just takes longer
+    import inspect
+
+    kwargs = {}
+    if "heartbeat_timeout_seconds" in inspect.signature(
+        jax.distributed.initialize
+    ).parameters:
+        kwargs["heartbeat_timeout_seconds"] = 10
     jax.distributed.initialize(
         coordinator_address="127.0.0.1:{}".format(port),
         num_processes=world,
         process_id=rank,
-        heartbeat_timeout_seconds=10,
+        **kwargs,
     )
     import numpy as np
     from jax.sharding import Mesh

@@ -477,8 +477,10 @@ def _make_data(n, d, seed=0):
 
 def _task_setup(n, d, seed=0):
     """BENCH_TASK selects the measured workload: ``binary`` (default; BASELINE
-    config #2 Higgs-like), ``multiclass`` (#3 CoverType-like, 7 classes), or
-    ``ranking`` (#4 MSLR-like LambdaMART, ~100-doc groups). Returns
+    config #2 Higgs-like), ``multiclass`` (#3 CoverType-like, 7 classes),
+    ``ranking`` (#4 MSLR-like LambdaMART, ~100-doc groups), or ``lossguide``
+    (LightGBM-style leaf-wise growth at BENCH_MAX_LEAVES, default 255 — the
+    O(max_leaves * n * d) rescan cost question, VERDICT r3 #7). Returns
     (DataMatrix kwargs-ready pieces, params dict, task label)."""
     task = os.getenv("BENCH_TASK", "binary")
     rng = np.random.RandomState(seed)
@@ -486,6 +488,13 @@ def _task_setup(n, d, seed=0):
     groups = None
     if task == "binary":
         params = {"objective": "binary:logistic"}
+    elif task == "lossguide":
+        params = {
+            "objective": "binary:logistic",
+            "grow_policy": "lossguide",
+            "max_leaves": int(os.getenv("BENCH_MAX_LEAVES", "255")),
+            "max_depth": 0,
+        }
     elif task == "multiclass":
         score = X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(n)
         y = np.digitize(score, np.quantile(score, np.linspace(0, 1, 8)[1:-1]))
@@ -502,7 +511,7 @@ def _task_setup(n, d, seed=0):
         X, y = X[:n_used], y[:n_used]
         params = {"objective": "rank:ndcg"}
     else:
-        raise ValueError("BENCH_TASK must be binary|multiclass|ranking")
+        raise ValueError("BENCH_TASK must be binary|multiclass|ranking|lossguide")
     return X, y, groups, params, task
 
 
@@ -536,6 +545,11 @@ def main():
     X, y, groups, task_params, task = _task_setup(N_ROWS, N_FEATURES)
     dtrain = DataMatrix(X, labels=y, groups=groups)
     rounds_per_dispatch = int(os.getenv("BENCH_ROUNDS_PER_DISPATCH", "10"))
+    if task == "lossguide":
+        # a K-round scan body contains K * (max_leaves - 1) unrolled split
+        # steps; at 255 leaves even K=10 is a wedge-scale compile on the
+        # tunneled chip — keep the program one tree deep
+        rounds_per_dispatch = min(rounds_per_dispatch, 1)
     if jax.default_backend() != "cpu" and rounds_per_dispatch > 10:
         # wedge playbook (docs/ROUND2_STATE.md): compiling a >10-iteration
         # scan has twice wedged the tunneled chip for hours — clamp
@@ -546,9 +560,10 @@ def main():
             )
         )
         rounds_per_dispatch = 10
-    params = dict(
-        task_params,
-        max_depth=MAX_DEPTH,
+    params = dict(task_params)
+    # task params may pin their own depth policy (lossguide: max_depth=0)
+    params.setdefault("max_depth", MAX_DEPTH)
+    params.update(
         eta=0.2,
         tree_method="hist",
         max_bin=256,
@@ -579,11 +594,16 @@ def main():
     elapsed = time.perf_counter() - start
 
     rounds_per_sec = done / elapsed
+    shape_note = (
+        "{} leaves (leaf-wise)".format(params["max_leaves"])
+        if task == "lossguide"
+        else "depth {}".format(MAX_DEPTH)
+    )
     print(
         json.dumps(
             {
-                "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, depth {}, {}){}".format(
-                    N_ROWS, N_FEATURES, MAX_DEPTH, params["objective"], backend_note
+                "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, {}, {}){}".format(
+                    N_ROWS, N_FEATURES, shape_note, params["objective"], backend_note
                 ),
                 "value": round(rounds_per_sec, 3),
                 "unit": "rounds/sec",

@@ -283,21 +283,12 @@ class _TrainingSession:
         self.objective.validate_labels(labels)
 
         self.is_ranking = getattr(self.objective, "needs_groups", False)
-        if (
-            self.objective.name == "survival:cox"
-            and mesh is not None
-            and jax.process_count() > 1
-            and evals
-        ):
-            # training gradients are exact (global risk sets via all_gather)
-            # but cox-nloglik is not decomposable, so multi-host watchlist
-            # lines would be a biased per-host average — refuse loudly
-            # rather than print wrong numbers
-            raise exc.UserError(
-                "survival:cox eval metrics are not supported in multi-host "
-                "training yet (the partial likelihood does not decompose "
-                "across hosts); drop the watchlist or train single-host."
-            )
+        # survival:cox multi-host watchlists are exact: the partial
+        # likelihood does not decompose across hosts, so cox-nloglik rides
+        # a dedicated global-rows path — all_gather over the data axis on
+        # device (device_metrics needs_global_rows) or process_allgather on
+        # the host evaluate() path — the same way the Cox gradients gather
+        # global risk sets (r3 parity debt, VERDICT #4).
         # ranking layouts: single device keeps the [G, M] global layout;
         # on a mesh, rows are re-partitioned BY GROUP (groups never straddle
         # shards, so intra-group pairwise gradients stay shard-exact — the
@@ -308,11 +299,12 @@ class _TrainingSession:
         self.rank_pos = None           # original (local) row -> device position
         self._rank_index_np = None     # [local_shards, G_max, M]
         if self.is_ranking:
-            if self.has_feature_axis:
-                raise exc.UserError(
-                    "Ranking objectives with feature-axis sharding are not "
-                    "supported yet"
-                )
+            # ranking composes with a feature axis: the group-partitioned
+            # row layout permutes ROWS only, so bins shard P("data",
+            # "feature") as usual, rank_index replicates over the feature
+            # axis, and the builder's cross-shard split combine + owner/psum
+            # routing (ops/tree_build, ops/lossguide) do the column work
+            # (r3 parity debt, VERDICT #4)
             if dtrain.groups is None:
                 # xgboost convention: absent group info = one group per dataset
                 groups = np.asarray([dtrain.num_row], np.int64)
@@ -323,7 +315,13 @@ class _TrainingSession:
             else:
                 from ..ops.ranking import build_sharded_group_layout
 
-                local_shards = max(1, len(mesh.local_devices)) if self.is_multiprocess else self.n_data_shards
+                # DATA shards only: with a feature axis, local_devices also
+                # counts column shards, which hold the same rows
+                local_shards = (
+                    max(1, int(mesh.local_mesh.shape["data"]))
+                    if self.is_multiprocess
+                    else self.n_data_shards
+                )
                 perm, ri, rps = build_sharded_group_layout(groups, local_shards)
                 if self.is_multiprocess:
                     # all hosts must agree on padded shapes
@@ -379,11 +377,30 @@ class _TrainingSession:
             )
             self.eval_sets.append((name, dm, binned))
 
+        def _agreed_pad(num_row):
+            """Local padded row count, agreed across processes. Hosts may
+            hold UNEVEN row counts (ShardedByS3Key): every process must pad
+            to the same local size or any global row gather (cox risk sets /
+            cox-nloglik metric) hits a cross-host collective size mismatch
+            (gloo: "402 vs 400") — equal device shards also keep the mesh
+            layout uniform. Applies to the train rows AND every eval set;
+            ranking agrees via its own maxima allgather above."""
+            pad = -(-num_row // self.pad_unit) * self.pad_unit
+            if not self.is_multiprocess:
+                return pad
+            from jax.experimental import multihost_utils
+
+            return int(
+                np.asarray(
+                    multihost_utils.process_allgather(np.asarray([pad], np.int64))
+                ).max()
+            )
+
         self.n = dtrain.num_row
         if self.rank_perm is not None:
             n_pad = len(self.rank_perm)   # local_shards * rows_per_shard
         else:
-            n_pad = -(-self.n // self.pad_unit) * self.pad_unit
+            n_pad = _agreed_pad(self.n)
 
         def _layout_rows(arr, fill):
             """Original-order rows -> device layout (tail padding, or the
@@ -474,7 +491,7 @@ class _TrainingSession:
                 self.eval_labels.append(self.labels)
                 self.eval_weights.append(self.weights)
                 continue
-            m_pad = -(-dm.num_row // self.pad_unit) * self.pad_unit
+            m_pad = _agreed_pad(dm.num_row)
             self.eval_bins.append(
                 _put(_pad_rows(binned.bins, m_pad, binned.max_bin), P("data", None))
             )
@@ -716,6 +733,7 @@ class _TrainingSession:
         metric_fns = self.device_metric_fns
         shared_flags = [b is None for b in self.eval_bins]
         predict_depth = cfg.predict_depth
+        n_data_shards = self.n_data_shards
 
         def multi_round(
             bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone,
@@ -771,9 +789,21 @@ class _TrainingSession:
                             ei += 1
                         # shard-local partial stats -> psum over the data
                         # axis -> finalize: metric scalars are globally
-                        # exact and identical on every shard/host
+                        # exact and identical on every shard/host. The
+                        # non-decomposable exception (cox-nloglik) gathers
+                        # the global rows first — its replicated stats are
+                        # pre-divided by the axis size so the shared psum
+                        # restores the global value.
+                        def _stats_for(fn, m_s, y_s, w_s):
+                            if fn.needs_global_rows and axis_name is not None:
+                                m_g = jax.lax.all_gather(m_s, axis_name, tiled=True)
+                                y_g = jax.lax.all_gather(y_s, axis_name, tiled=True)
+                                w_g = jax.lax.all_gather(w_s, axis_name, tiled=True)
+                                return fn.partial(m_g, y_g, w_g) / n_data_shards
+                            return fn.partial(m_s, y_s, w_s)
+
                         stats = jnp.concatenate(
-                            [fn.partial(m_e, y_e, w_e) for fn in metric_fns]
+                            [_stats_for(fn, m_e, y_e, w_e) for fn in metric_fns]
                         )
                         if axis_name is not None:
                             stats = jax.lax.psum(stats, axis_name)
@@ -993,6 +1023,63 @@ class _TrainingSession:
                     if self.is_multiprocess
                     else None
                 )
+                if dmf is not None and dmf.needs_global_rows:
+                    # non-decomposable (cox-nloglik): gather every host's
+                    # rows (padded to the max local length, weight 0) and
+                    # evaluate on the global arrays — exact and identical
+                    # on every host, the host-side mirror of the device
+                    # all_gather path. Labels/weights (and the agreed max
+                    # length) are round-invariant: gathered once per eval
+                    # set and cached; only the margins travel per round.
+                    from jax.experimental import multihost_utils
+
+                    n_loc = int(dm.num_row)
+
+                    def _padded(a, n_max):
+                        out = np.zeros(n_max, np.float32)
+                        out[:n_loc] = np.asarray(a, np.float32)[:n_loc]
+                        return out
+
+                    cache = getattr(self, "_global_rows_cache", None)
+                    if cache is None:
+                        cache = self._global_rows_cache = {}
+                    if i not in cache:
+                        w_arr = (
+                            np.asarray(w, np.float32)
+                            if w is not None
+                            else np.ones(n_loc, np.float32)
+                        )
+                        n_max = int(
+                            np.asarray(
+                                multihost_utils.process_allgather(
+                                    np.asarray([n_loc], np.int64)
+                                )
+                            ).max()
+                        )
+                        yw = np.asarray(
+                            multihost_utils.process_allgather(
+                                np.stack(
+                                    [_padded(dm.labels, n_max), _padded(w_arr, n_max)]
+                                )
+                            ),
+                            np.float64,
+                        )  # [P, 2, n_max]
+                        cache[i] = (n_max, yw[:, 0].ravel(), yw[:, 1].ravel())
+                    n_max, y_g, w_g = cache[i]
+                    m_g = np.asarray(
+                        multihost_utils.process_allgather(_padded(margin, n_max)),
+                        np.float64,
+                    ).ravel()
+                    value = eval_metrics.evaluate(
+                        metric,
+                        self.objective.margin_to_prediction(m_g),
+                        y_g,
+                        w_g,
+                    )
+                    results.append((name, metric, value))
+                    # identical on every host: combines to mean(value)
+                    append_weighted_mean(value, 1.0)
+                    continue
                 if dmf is not None:
                     # decomposable: combine exactly from per-host partial
                     # stats; skip the (discarded) host-local evaluation

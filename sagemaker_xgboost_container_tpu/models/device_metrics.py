@@ -26,13 +26,21 @@ _EPS = 1e-15
 
 
 class DeviceMetric:
-    """A decomposable metric: ``partial`` -> psum-able f32 [size] -> ``finalize``."""
+    """A decomposable metric: ``partial`` -> psum-able f32 [size] -> ``finalize``.
 
-    def __init__(self, name, size, partial, finalize):
+    ``needs_global_rows`` marks the one exception (cox-nloglik): its partial
+    is NOT shard-decomposable — the caller must all_gather the row shards
+    over the data axis, call ``partial`` on the replicated global arrays,
+    and divide by the axis size so the shared downstream psum restores the
+    global value (mirroring the booster's Cox gradient path, which gathers
+    global risk sets the same way)."""
+
+    def __init__(self, name, size, partial, finalize, needs_global_rows=False):
         self.name = name
         self.size = size
         self.partial = partial
         self.finalize = finalize
+        self.needs_global_rows = needs_global_rows
 
     def __call__(self, margins, labels, weights):
         return self.finalize(self.partial(margins, labels, weights))
@@ -181,6 +189,33 @@ def make_device_metric(name, objective_name, num_group=1, params=None):
             return jnp.log(p / yy) + yy / p - 1.0
 
         return wm(term, post=lambda x: 2.0 * x)
+    if base == "cox-nloglik":
+        def partial(m, y, w):
+            # negative Breslow partial log-likelihood (device form of
+            # eval_metrics.cox_nloglik): labels < 0 = censored at |t|,
+            # hazard ratio = exp(margin); risk sets are cumulative sums
+            # over the descending-time ordering. Padding rows (weight 0)
+            # contribute nothing to either the risk sets or the events.
+            p = jnp.exp(m)
+            abs_t = jnp.abs(y)
+            event = (y > 0).astype(jnp.float32)
+            order = jnp.argsort(-abs_t)  # stable, matches the host metric
+            hz = jnp.maximum(p, 1e-30)[order] * w[order]
+            cum = jnp.cumsum(hz)
+            ev = (event * w)[order]
+            ll = jnp.sum(
+                ev
+                * (jnp.log(jnp.maximum(hz, 1e-30)) - jnp.log(jnp.maximum(cum, 1e-30)))
+            )
+            return jnp.stack([-ll, jnp.sum(ev)])
+
+        return DeviceMetric(
+            name,
+            2,
+            partial,
+            lambda s: s[0] / jnp.maximum(s[1], 1e-12),
+            needs_global_rows=True,
+        )
     if base == "tweedie-nloglik":
         rho = float(suffix) if suffix else float(params.get("tweedie_variance_power", 1.5))
 

@@ -176,7 +176,13 @@ def cox_nloglik(preds, labels, weights=None):
     hz = np.maximum(np.asarray(preds, np.float64), 1e-300)[order] * w[order]
     cum_risk = np.cumsum(hz)
     ev = (event * w)[order]
-    ll = np.sum(ev * (np.log(hz) - np.log(np.maximum(cum_risk, 1e-300))))
+    # clamp hz inside the log: weight-0 rows (sample weights or multi-host
+    # gather padding) have hz=0, and 0 * log(0) would NaN the whole metric
+    # even though ev=0 makes their true contribution zero
+    ll = np.sum(
+        ev
+        * (np.log(np.maximum(hz, 1e-300)) - np.log(np.maximum(cum_risk, 1e-300)))
+    )
     n_events = max(ev.sum(), 1e-12)
     return float(-ll / n_events)
 

@@ -53,7 +53,12 @@ def _leaf_nodes_impl(
             # wraps on numpy but saturates on XLA:TPU, so an int-side
             # comparison would diverge between the host and device paths
             invalid = (v < 0) | (v >= max_cat)
-            cat = xp.nan_to_num(v, nan=-1.0).astype(xp.int32)
+            # clip the FLOAT before the int cast: inf / >=2^31 values would
+            # otherwise warn on numpy (and saturate on XLA); the `invalid`
+            # flag above already captured out-of-range on the float side
+            cat = xp.clip(
+                xp.nan_to_num(v, nan=-1.0), -1.0, float(max_cat)
+            ).astype(xp.int32)
             safe_cat = xp.clip(cat, 0, max_cat - 1)
             word = cat_mask[t_idx, node, safe_cat >> 5]
             in_set = ((word >> (safe_cat & 31).astype(xp.uint32)) & 1) == 1

@@ -192,6 +192,36 @@ def _training_mesh(num_devices_cap=None):
     return Mesh(np.array(devices[:n]), axis_names=("data",))
 
 
+def _accelerator_runtime_present():
+    """True when an accelerator backend could come up: the libtpu wheel
+    (TPU images) or any registered PJRT plugin. Never initializes a
+    backend. CPU-only hosts (no plugin) return False, so auto-mode skips
+    distributed init there — the pre-r4 behavior."""
+    import importlib.util
+
+    if importlib.util.find_spec("libtpu") is not None:
+        return True
+    try:
+        from importlib.metadata import entry_points
+
+        eps = entry_points()
+        group = (
+            eps.select(group="jax_plugins")
+            if hasattr(eps, "select")
+            else eps.get("jax_plugins", [])
+        )
+        if len(list(group)):
+            return True
+    except Exception:  # metadata backends vary; absence of evidence -> no accel
+        pass
+    try:
+        import jax_plugins  # namespace package populated by installed plugins
+
+        return len(list(getattr(jax_plugins, "__path__", []))) > 0
+    except ImportError:
+        return False
+
+
 def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
     """Bring up the multi-host XLA runtime (coordinator = sorted hosts[0]).
 
@@ -213,9 +243,29 @@ def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
 
     if len(sm_hosts) <= 1:
         return False
-    if os.environ.get("SM_JAX_DISTRIBUTED", "auto") == "off":
+    mode = os.environ.get("SM_JAX_DISTRIBUTED", "auto")
+    if mode == "off":
         return False
-    if jax.default_backend() == "cpu":
+    # Platform detection WITHOUT jax.default_backend(): touching the backend
+    # would initialize it, and jax.distributed.initialize() must run first
+    # ("must be called before any JAX computations") — the previous
+    # default_backend() probe would have PlatformError'd every real
+    # multi-host TPU job at startup. Read the requested-platform config;
+    # when unset, sniff for an accelerator runtime (libtpu wheel / PJRT
+    # plugin) instead of initializing one.
+    platforms = (
+        os.environ.get("JAX_PLATFORMS")
+        or getattr(jax.config, "jax_platforms", None)
+        or ""
+    )
+    if platforms:
+        cpu_only = set(platforms.split(",")) <= {"cpu"}
+    else:
+        cpu_only = not _accelerator_runtime_present()
+    if cpu_only and mode != "on":
+        # "auto" skips CPU (the in-process mesh tests cover that path);
+        # "on" forces a real multi-process CPU cluster — used by the
+        # docker-compose image tier to exercise true cross-host training
         logger.info("Skipping jax.distributed on the CPU backend")
         return False
     hosts = sorted(sm_hosts)

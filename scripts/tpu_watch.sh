@@ -63,4 +63,7 @@ run 900 bench_serve.log python bench_serve.py
 run 1800 bench_reprobe.log BENCH_REPROBE=1 python bench.py
 run 1500 bench_multiclass.log GRAFT_HIST_IMPL=pallas BENCH_TASK=multiclass python bench.py
 run 1500 bench_ranking.log GRAFT_HIST_IMPL=pallas BENCH_TASK=ranking python bench.py
+# leaf-wise at LightGBM scale (VERDICT r3 #7): smaller row count + few
+# rounds — the 254-step unrolled tree is a heavy compile on the tunnel
+run 1500 bench_lossguide.log GRAFT_HIST_IMPL=pallas BENCH_TASK=lossguide BENCH_ROWS=250000 BENCH_ROUNDS_N=4 BENCH_WARMUP=1 python bench.py
 echo "[watch] done $(date +%H:%M:%S)" >> "$OUT/watch.log"

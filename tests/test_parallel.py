@@ -787,6 +787,61 @@ def test_two_process_global_metrics_exact():
         )
 
 
+def test_two_process_cox_watchlist_exact():
+    """r3 parity lift (VERDICT #4): survival:cox + watchlist in a 2-process
+    pod — previously a UserError. cox-nloglik lines must be identical on
+    both hosts and equal to the global metric of the final model over the
+    combined rows, on both the device-scan and host-evaluate paths."""
+    import multiprocessing as mp
+
+    from tests.util_multiprocess import cox_metrics_worker
+    from tests.util_ports import free_port
+
+    port = free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=cox_metrics_worker, args=(r, 2, port, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    got = {}
+    for _ in range(2):
+        rank, dev_log, host_log, check = q.get(timeout=300)
+        got[rank] = (dev_log, host_log, check)
+    for p in procs:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+
+    for key in ("train", "validation"):
+        np.testing.assert_allclose(
+            got[0][0][key]["cox-nloglik"], got[1][0][key]["cox-nloglik"],
+            rtol=1e-6, err_msg=f"device {key} lines must agree across hosts",
+        )
+        np.testing.assert_allclose(
+            got[0][1][key]["cox-nloglik"], got[1][1][key]["cox-nloglik"],
+            rtol=1e-6, err_msg=f"host {key} lines must agree across hosts",
+        )
+    check = got[0][2]
+    np.testing.assert_allclose(
+        got[0][0]["train"]["cox-nloglik"][-1], check["train_cox"],
+        rtol=5e-4, atol=1e-5, err_msg="device-path global exactness",
+    )
+    np.testing.assert_allclose(
+        got[0][0]["validation"]["cox-nloglik"][-1], check["val_cox"],
+        rtol=5e-4, atol=1e-5, err_msg="device-path eval-set exactness (uneven)",
+    )
+    np.testing.assert_allclose(
+        got[0][1]["train"]["cox-nloglik"][-1], check["host3_cox"],
+        rtol=5e-4, atol=1e-5, err_msg="host-path global exactness",
+    )
+    np.testing.assert_allclose(
+        got[0][1]["validation"]["cox-nloglik"][-1], check["host3_val_cox"],
+        rtol=5e-4, atol=1e-5, err_msg="host-path eval-set exactness (uneven)",
+    )
+
+
 @pytest.mark.multichip
 def test_ranking_on_mesh_matches_single_device(mesh8):
     """VERDICT r1 item 3: rank:ndcg trains on a data mesh — rows sharded BY
@@ -825,6 +880,33 @@ def test_ranking_on_mesh_matches_single_device(mesh8):
         evals=[(dtrain, "train")], callbacks=[Rec()], mesh=mesh8,
     )
     assert "train" in log and len(next(iter(log["train"].values()))) == 4
+
+
+@pytest.mark.multichip
+def test_ranking_on_2d_mesh_matches_single_device():
+    """r3 parity lift (VERDICT #4): rank:ndcg on a (data x feature) mesh —
+    the group-partitioned row layout composes with column sharding; trees
+    must match single-device."""
+    from jax.sharding import Mesh as JMesh
+
+    rng = np.random.RandomState(23)
+    n_groups = 48
+    sizes = rng.randint(5, 40, n_groups).astype(np.int32)
+    n = int(sizes.sum())
+    X = rng.randn(n, 5).astype(np.float32)  # d=5 pads to 6 over 2 shards
+    relevance = np.clip(np.round(X[:, 0] * 1.5 + 1.5), 0, 4).astype(np.float32)
+    dtrain = DataMatrix(X, labels=relevance, groups=sizes)
+
+    params = {"objective": "rank:ndcg", "max_depth": 3, "eta": 0.3, "seed": 4}
+    single = train(dict(params), dtrain, num_boost_round=6)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = JMesh(devices, axis_names=("data", "feature"))
+    sharded = train(dict(params), dtrain, num_boost_round=6, mesh=mesh2d)
+
+    p1, p2 = single.predict(X), sharded.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-3, atol=1e-3)
+    ndcg = eval_metric("ndcg", p2, relevance, groups=sizes)
+    assert ndcg > 0.85, ndcg
 
 
 @pytest.mark.multichip
@@ -964,6 +1046,91 @@ def test_survival_cox_on_mesh_matches_single_device(mesh8):
     m = sharded.predict(X, output_margin=True)
     corr = np.corrcoef(m, np.log(hazard))[0, 1]
     assert corr > 0.6, corr
+
+
+def _cox_data(n=1024, seed=31):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4).astype(np.float32)
+    hazard = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1])
+    times = rng.exponential(1.0 / hazard).astype(np.float32) + 0.01
+    censored = rng.rand(n) < 0.3
+    labels = np.where(censored, -times, times).astype(np.float32)
+    return X, labels
+
+
+def test_cox_nloglik_device_metric_matches_host():
+    """The device cox-nloglik (argsort + cumsum risk sets) must agree with
+    the host eval_metrics formulation, including weight-0 padding rows."""
+    from sagemaker_xgboost_container_tpu.models.device_metrics import (
+        make_device_metric,
+    )
+    from sagemaker_xgboost_container_tpu.models.eval_metrics import cox_nloglik
+
+    _, labels = _cox_data(400)
+    rng = np.random.RandomState(5)
+    margins = rng.randn(400).astype(np.float32) * 0.5
+    weights = rng.rand(400).astype(np.float32) + 0.5
+
+    dmf = make_device_metric("cox-nloglik", "survival:cox")
+    assert dmf is not None and dmf.needs_global_rows
+    import jax.numpy as jnp
+
+    got = float(dmf(jnp.asarray(margins), jnp.asarray(labels), jnp.asarray(weights)))
+    want = cox_nloglik(np.exp(margins.astype(np.float64)), labels, weights)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+
+    # padding rows (weight 0) must be inert — on the device metric AND the
+    # host formula (0 * log(0) NaN hazard, r4 review finding)
+    m_pad = np.concatenate([margins, np.ones(37, np.float32)])
+    y_pad = np.concatenate([labels, np.zeros(37, np.float32)])
+    w_pad = np.concatenate([weights, np.zeros(37, np.float32)])
+    got_pad = float(dmf(jnp.asarray(m_pad), jnp.asarray(y_pad), jnp.asarray(w_pad)))
+    np.testing.assert_allclose(got_pad, want, rtol=2e-4)
+    host_pad = cox_nloglik(np.exp(m_pad.astype(np.float64)), y_pad, w_pad)
+    assert np.isfinite(host_pad)
+    np.testing.assert_allclose(host_pad, want, rtol=1e-6)
+
+
+@pytest.mark.multichip
+def test_cox_watchlist_on_mesh_k_batched(mesh8):
+    """r3 parity lift (VERDICT #4): survival:cox eval metrics on a mesh with
+    K-round batching — the non-decomposable cox-nloglik gathers global rows
+    inside the jitted scan; every line must match the host oracle computed
+    from the final model on the full dataset."""
+    X, labels = _cox_data(900)
+    dtrain = DataMatrix(X[:700], labels=labels[:700])
+    dval = DataMatrix(X[700:], labels=labels[700:])
+    log = {}
+
+    class Recorder:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update({k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()})
+            return False
+
+    params = {
+        "objective": "survival:cox",
+        "max_depth": 3,
+        "eta": 0.3,
+        "seed": 3,
+        "_rounds_per_dispatch": 3,
+    }
+    forest = train(
+        params,
+        dtrain,
+        num_boost_round=6,
+        evals=[(dtrain, "train"), (dval, "validation")],
+        callbacks=[Recorder()],
+        mesh=mesh8,
+    )
+    from sagemaker_xgboost_container_tpu.models.eval_metrics import cox_nloglik
+
+    for tag, (Xf, yf) in (
+        ("train", (X[:700], labels[:700])),
+        ("validation", (X[700:], labels[700:])),
+    ):
+        want = cox_nloglik(np.asarray(forest.predict(Xf), np.float64), yf)
+        got = log[tag]["cox-nloglik"][-1]
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-5)
 
 
 @pytest.mark.multichip

@@ -139,6 +139,107 @@ def distributed_metrics_worker(rank, world, port, q):
     q.put((rank, dev_log, host_log, check))
 
 
+def cox_metrics_worker(rank, world, port, q):
+    """2-process pod with survival:cox + watchlist (r3 parity debt): the
+    cox-nloglik lines must be globally exact and identical on every host —
+    both on the device scan path (K>1) and the host evaluate() path (feval
+    forces it)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world,
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.models.eval_metrics import cox_nloglik
+
+    rng = np.random.RandomState(31)
+    n = 800
+    X = rng.rand(n, 4).astype(np.float32)
+    hazard = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1])
+    times = rng.exponential(1.0 / hazard).astype(np.float32) + 0.01
+    censored = rng.rand(n) < 0.3
+    y = np.where(censored, -times, times).astype(np.float32)
+    # UNEVEN shards (401 vs 399): the host evaluate() gather pads to the max
+    # local length with weight-0 rows — the NaN hazard the r4 review caught
+    lo, hi = (0, 401) if rank == 0 else (401, n)
+    dtrain = DataMatrix(X[lo:hi], labels=y[lo:hi])
+    # separate validation set, also UNEVEN (121 vs 119): eval-set padding
+    # must be cross-process agreed too or its global row gathers mismatch
+    Xv = rng.rand(240, 4).astype(np.float32)
+    hv = np.exp(0.8 * Xv[:, 0] - 0.5 * Xv[:, 1])
+    tv = rng.exponential(1.0 / hv).astype(np.float32) + 0.01
+    yv = np.where(rng.rand(240) < 0.3, -tv, tv).astype(np.float32)
+    vlo, vhi = (0, 121) if rank == 0 else (121, 240)
+    dval = DataMatrix(Xv[vlo:vhi], labels=yv[vlo:vhi])
+    mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+
+    def recorder(log):
+        class Rec:
+            def after_iteration(self, model, epoch, evals_log):
+                log.update(
+                    {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+                )
+                return False
+
+        return Rec()
+
+    params = {
+        "objective": "survival:cox",
+        "max_depth": 3,
+        "eta": 0.3,
+        "seed": 3,
+        "_rounds_per_dispatch": 3,
+    }
+    dev_log = {}
+    forest = train(
+        params, dtrain, num_boost_round=6,
+        evals=[(dtrain, "train"), (dval, "validation")],
+        callbacks=[recorder(dev_log)], mesh=mesh,
+    )
+    # oracle: global metric of the final model over the COMBINED rows
+    check = {
+        "train_cox": cox_nloglik(
+            np.asarray(forest.predict(X), np.float64), y
+        ),
+        "val_cox": cox_nloglik(
+            np.asarray(forest.predict(Xv), np.float64), yv
+        ),
+    }
+
+    # host evaluate() path: a feval forces host-side evaluation, where
+    # cox-nloglik must ride the process_allgather global-rows branch
+    def feval(margin, dm):
+        return [("mmean", float(np.mean(margin)))]
+
+    host_log = {}
+    params_host = dict(params)
+    params_host.pop("_rounds_per_dispatch")
+    forest2 = train(
+        params_host, dtrain, num_boost_round=3,
+        evals=[(dtrain, "train"), (dval, "validation")], feval=feval,
+        callbacks=[recorder(host_log)], mesh=mesh,
+    )
+    check["host3_cox"] = cox_nloglik(
+        np.asarray(forest2.predict(X), np.float64), y
+    )
+    check["host3_val_cox"] = cox_nloglik(
+        np.asarray(forest2.predict(Xv), np.float64), yv
+    )
+    q.put((rank, dev_log, host_log, check))
+
+
 def host_loss_worker(rank, world, port, q):
     """2-process pod where rank 1 dies mid-train (simulated host loss /
     preemption). Contract under test (VERDICT r2 missing #5): the SURVIVOR

@@ -996,155 +996,192 @@ class _TrainingSession:
         xgboost, where python-side custom metrics are computed per worker
         and averaged rather than allreduced elementwise.
         """
-        from .device_metrics import make_device_metric
+        if not hasattr(self, "_global_rows_cache"):
+            self._global_rows_cache = {}
+        entries = (
+            (name, dm, self.margins_for(i))
+            for i, (name, dm, _binned) in enumerate(self.eval_sets)
+        )
+        return evaluate_host_lines(
+            entries,
+            metric_names,
+            feval,
+            self.objective,
+            self.num_group,
+            self.config.objective_params,
+            self.is_multiprocess,
+            global_rows_cache=self._global_rows_cache,
+        )
 
-        results = []       # (name, metric, local_value or None placeholder)
-        pairs = []         # per entry: [a, b] stats (f32 on device) to sum
-        finalizers = []    # per entry: fn(summed [a, b]) -> global value
 
-        def append_weighted_mean(value, wsum):
-            pairs.append(np.asarray([value * wsum, wsum], np.float64))
-            finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
+def evaluate_host_lines(
+    entries,
+    metric_names,
+    feval,
+    objective,
+    num_group,
+    objective_params,
+    is_multiprocess,
+    global_rows_cache=None,
+):
+    """Host-side metric lines for ``entries`` of (name, dm, margin).
 
-        for i, (name, dm, binned) in enumerate(self.eval_sets):
-            margin = self.margins_for(i)
-            preds = None
-            prob_matrix = None
-            w = dm.get_weight()
-            wsum = float(np.sum(w)) if w is not None else float(dm.num_row)
-            for metric in metric_names:
-                dmf = (
-                    make_device_metric(
-                        metric,
-                        self.objective.name,
-                        self.num_group,
-                        self.config.objective_params,
-                    )
-                    if self.is_multiprocess
-                    else None
-                )
-                if dmf is not None and dmf.needs_global_rows:
-                    # non-decomposable (cox-nloglik): gather every host's
-                    # rows (padded to the max local length, weight 0) and
-                    # evaluate on the global arrays — exact and identical
-                    # on every host, the host-side mirror of the device
-                    # all_gather path. Labels/weights (and the agreed max
-                    # length) are round-invariant: gathered once per eval
-                    # set and cached; only the margins travel per round.
-                    from jax.experimental import multihost_utils
+    Single-process: plain host evaluation. Multi-process, per metric:
+    decomposable metrics combine EXACTLY from per-host partial stats
+    (device_metrics); the cox-nloglik exception gathers the global rows
+    (labels/weights cached round-invariant in ``global_rows_cache``, keyed
+    by entry position); everything else (ndcg/map/feval) combines as a
+    weight-sum-weighted mean — all hosts return identical lines. Shared by
+    the tree booster's evaluate(), gblinear, and dart."""
+    from .device_metrics import make_device_metric
 
-                    n_loc = int(dm.num_row)
+    results = []       # (name, metric, local_value or None placeholder)
+    pairs = []         # per entry: summable stats vector
+    finalizers = []    # per entry: fn(summed stats) -> global value
 
-                    def _padded(a, n_max):
-                        out = np.zeros(n_max, np.float32)
-                        out[:n_loc] = np.asarray(a, np.float32)[:n_loc]
-                        return out
+    def append_weighted_mean(value, wsum):
+        pairs.append(np.asarray([value * wsum, wsum], np.float64))
+        finalizers.append(lambda s: float(s[0] / max(s[1], 1e-12)))
 
-                    cache = getattr(self, "_global_rows_cache", None)
-                    if cache is None:
-                        cache = self._global_rows_cache = {}
-                    if i not in cache:
-                        w_arr = (
-                            np.asarray(w, np.float32)
-                            if w is not None
-                            else np.ones(n_loc, np.float32)
-                        )
-                        n_max = int(
-                            np.asarray(
-                                multihost_utils.process_allgather(
-                                    np.asarray([n_loc], np.int64)
-                                )
-                            ).max()
-                        )
-                        yw = np.asarray(
-                            multihost_utils.process_allgather(
-                                np.stack(
-                                    [_padded(dm.labels, n_max), _padded(w_arr, n_max)]
-                                )
-                            ),
-                            np.float64,
-                        )  # [P, 2, n_max]
-                        cache[i] = (n_max, yw[:, 0].ravel(), yw[:, 1].ravel())
-                    n_max, y_g, w_g = cache[i]
-                    m_g = np.asarray(
-                        multihost_utils.process_allgather(_padded(margin, n_max)),
-                        np.float64,
-                    ).ravel()
-                    value = eval_metrics.evaluate(
-                        metric,
-                        self.objective.margin_to_prediction(m_g),
-                        y_g,
-                        w_g,
-                    )
-                    results.append((name, metric, value))
-                    # identical on every host: combines to mean(value)
-                    append_weighted_mean(value, 1.0)
-                    continue
-                if dmf is not None:
-                    # decomposable: combine exactly from per-host partial
-                    # stats; skip the (discarded) host-local evaluation
+    for i, (name, dm, margin) in enumerate(entries):
+        preds = None
+        prob_matrix = None
+        w = dm.get_weight()
+        wsum = float(np.sum(w)) if w is not None else float(dm.num_row)
+        for metric in metric_names:
+            dmf = (
+                make_device_metric(metric, objective.name, num_group, objective_params)
+                if is_multiprocess
+                else None
+            )
+            if dmf is not None and dmf.needs_global_rows:
+                # non-decomposable (cox-nloglik): gather every host's rows
+                # (padded to the max local length, weight 0) and evaluate on
+                # the global arrays — exact and identical on every host, the
+                # host-side mirror of the device all_gather path. Labels/
+                # weights (and the agreed max length) are round-invariant:
+                # gathered once per eval set and cached; only the margins
+                # travel per round.
+                from jax.experimental import multihost_utils
+
+                n_loc = int(dm.num_row)
+
+                def _padded(a, n_max):
+                    out = np.zeros(n_max, np.float32)
+                    out[:n_loc] = np.asarray(a, np.float32)[:n_loc]
+                    return out
+
+                cache = global_rows_cache if global_rows_cache is not None else {}
+                if i not in cache:
                     w_arr = (
                         np.asarray(w, np.float32)
                         if w is not None
-                        else np.ones(dm.num_row, np.float32)
+                        else np.ones(n_loc, np.float32)
                     )
-                    stats = np.asarray(
-                        dmf.partial(
-                            jnp.asarray(margin),
-                            jnp.asarray(dm.labels),
-                            jnp.asarray(w_arr),
+                    n_max = int(
+                        np.asarray(
+                            multihost_utils.process_allgather(
+                                np.asarray([n_loc], np.int64)
+                            )
+                        ).max()
+                    )
+                    yw = np.asarray(
+                        multihost_utils.process_allgather(
+                            np.stack(
+                                [_padded(dm.labels, n_max), _padded(w_arr, n_max)]
+                            )
                         ),
                         np.float64,
-                    )
-                    results.append((name, metric, None))
-                    pairs.append(stats)
-                    finalizers.append(
-                        lambda s, f=dmf: float(f.finalize(jnp.asarray(s, dtype=jnp.float32)))
-                    )
-                    continue
-                if preds is None:
-                    preds = self.objective.margin_to_prediction(margin)
-                    if self.num_group > 1:
-                        prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
-                            self.objective, margin
-                        )
+                    )  # [P, 2, n_max]
+                    cache[i] = (n_max, yw[:, 0].ravel(), yw[:, 1].ravel())
+                n_max, y_g, w_g = cache[i]
+                m_g = np.asarray(
+                    multihost_utils.process_allgather(_padded(margin, n_max)),
+                    np.float64,
+                ).ravel()
                 value = eval_metrics.evaluate(
-                    metric,
-                    preds,
-                    dm.labels,
-                    dm.weights,
-                    groups=dm.groups,
-                    prob_matrix=prob_matrix,
+                    metric, objective.margin_to_prediction(m_g), y_g, w_g
                 )
                 results.append((name, metric, value))
-                if self.is_multiprocess:
-                    # non-decomposable (ndcg/map): weight-sum-weighted mean
+                # identical on every host: combines to mean(value)
+                append_weighted_mean(value, 1.0)
+                continue
+            if dmf is not None:
+                # decomposable: combine exactly from per-host partial
+                # stats; skip the (discarded) host-local evaluation
+                w_arr = (
+                    np.asarray(w, np.float32)
+                    if w is not None
+                    else np.ones(dm.num_row, np.float32)
+                )
+                stats = np.asarray(
+                    dmf.partial(
+                        jnp.asarray(margin),
+                        jnp.asarray(dm.labels),
+                        jnp.asarray(w_arr),
+                    ),
+                    np.float64,
+                )
+                results.append((name, metric, None))
+                pairs.append(stats)
+                finalizers.append(
+                    lambda s, f=dmf: float(
+                        f.finalize(jnp.asarray(s, dtype=jnp.float32))
+                    )
+                )
+                continue
+            if preds is None:
+                preds = objective.margin_to_prediction(margin)
+                if num_group > 1:
+                    prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
+                        objective, margin
+                    )
+            value = eval_metrics.evaluate(
+                metric,
+                preds,
+                dm.labels,
+                dm.weights,
+                groups=dm.groups,
+                prob_matrix=prob_matrix,
+            )
+            results.append((name, metric, value))
+            if is_multiprocess:
+                # non-decomposable (ndcg/map): weight-sum-weighted mean
+                append_weighted_mean(value, wsum)
+        if feval is not None:
+            # xgboost >= 1.2 convention: feval receives the raw margin
+            for metric_name, value in feval(margin, dm):
+                results.append((name, metric_name, value))
+                if is_multiprocess:
                     append_weighted_mean(value, wsum)
-            if feval is not None:
-                # xgboost >= 1.2 convention: feval receives the raw margin
-                for metric_name, value in feval(margin, dm):
-                    results.append((name, metric_name, value))
-                    if self.is_multiprocess:
-                        append_weighted_mean(value, wsum)
-        if not self.is_multiprocess or not results:
-            return results
-        from jax.experimental import multihost_utils
+    if not is_multiprocess or not results:
+        return results
+    return combine_host_metric_entries(results, pairs, finalizers)
 
-        # device partial stats are f32 (x64 is not enabled); the allgather
-        # rides the device too, so transport is f32 — the cross-host SUM
-        # happens host-side in f64 to avoid accumulating f32 rounding over
-        # many hosts
-        gathered = np.asarray(
-            multihost_utils.process_allgather(
-                np.stack(pairs, axis=0).astype(np.float32)
-            ),
-            np.float64,
-        )  # [P, n_entries, 2]
-        summed = gathered.sum(axis=0)
-        return [
-            (name, metric, finalizers[j](summed[j]))
-            for j, (name, metric, _v) in enumerate(results)
-        ]
+
+def combine_host_metric_entries(results, pairs, finalizers):
+    """Cross-host combine of per-entry metric stats -> identical lines.
+
+    ``results``: [(name, metric, local_value_or_None)] in a deterministic
+    order identical on every host; ``pairs[j]``: the entry's summable stats
+    vector; ``finalizers[j]``: fn(summed stats) -> float. Device partial
+    stats are f32 (x64 is not enabled); the allgather rides the device too,
+    so transport is f32 — the cross-host SUM happens host-side in f64 to
+    avoid accumulating f32 rounding over many hosts. Shared by the tree
+    booster's evaluate() and the gblinear eval loop."""
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(
+            np.stack(pairs, axis=0).astype(np.float32)
+        ),
+        np.float64,
+    )  # [P, n_entries, stat_size]
+    summed = gathered.sum(axis=0)
+    return [
+        (name, metric, finalizers[j](summed[j]))
+        for j, (name, metric, _v) in enumerate(results)
+    ]
 
 
 def train(
@@ -1226,7 +1263,8 @@ def train(
         from .update import train_update
 
         return train_update(
-            config, forest, dtrain, list(evals), feval, callbacks, num_boost_round
+            config, forest, dtrain, list(evals), feval, callbacks, num_boost_round,
+            mesh=mesh,
         )
 
     if config.booster == "dart":

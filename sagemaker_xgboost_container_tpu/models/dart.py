@@ -33,11 +33,23 @@ logger = logging.getLogger(__name__)
 def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round, mesh=None):
     if config.num_class > 1:
         raise exc.UserError("booster=dart with multi-class objectives is not supported yet.")
-    if mesh is not None and jax.process_count() > 1:
+    # multi-process: rows shard across hosts exactly like the tree booster;
+    # the jitted builder runs on the global arrays (GSPMD combines), eval
+    # lines combine across hosts, dropout draws ride the shared seed so all
+    # hosts drop identical tree sets (reference parity: libxgboost's dart
+    # trains under Rabit like any other updater). A multi-process run MUST
+    # carry a cross-host data mesh — anything else would silently train a
+    # divergent per-host model, so refuse loudly (checked BEFORE the
+    # axis-name fallback below).
+    is_multiproc = jax.process_count() > 1
+    if is_multiproc and (
+        mesh is None
+        or "data" not in getattr(mesh, "axis_names", ())
+        or int(mesh.shape["data"]) <= 1
+    ):
         raise exc.UserError(
-            "booster=dart does not support multi-process distributed training "
-            "yet; run single-host (multi-device meshes within one host are "
-            "supported)."
+            "Multi-process booster=dart training requires a mesh with a "
+            "'data' axis spanning the hosts."
         )
     if mesh is not None and "data" not in getattr(mesh, "axis_names", ()):
         mesh = None
@@ -65,6 +77,18 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
     metric_names = _eval_metric_names(config, session.objective)
 
     # build trees with unit shrinkage; dart applies its own scaling
+    jit_kwargs = {}
+    if is_multiproc:
+        # the small tree arrays must come back replicated so every host can
+        # pull them (np.asarray on a non-addressable sharded output would
+        # fail); row_out stays sharded with the rows
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.tree_build import _TREE_FIELDS
+
+        tree_spec = {k: NamedSharding(mesh, P()) for k in _TREE_FIELDS}
+        jit_kwargs["out_shardings"] = (tree_spec, NamedSharding(mesh, P("data")))
     builder = jax.jit(
         lambda bins, g, h, num_cuts, mask, rng: build_tree(
             bins, g, h, num_cuts,
@@ -79,7 +103,8 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             feature_mask=mask,
             colsample_bylevel=config.colsample_bylevel,
             rng=rng,
-        )
+        ),
+        **jit_kwargs,
     )
     grad_fn = jax.jit(session.objective.grad_hess)
 
@@ -94,15 +119,25 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
         from ..ops.predict import forest_leaf_margins
 
         stacked = forest._stack(slice(0, len(forest.trees)))
-        leaf = forest_leaf_margins(stacked, dtrain.features)  # [n, T]
-        n_pad = session.bins.shape[0]
-        if leaf.shape[0] != n_pad:  # mesh padding: align with session rows
+        leaf = forest_leaf_margins(stacked, dtrain.features)  # [n_local, T]
+        n_pad = session.bins.shape[0]  # global padded rows
+        if is_multiproc:
+            # this host's rows -> its segment of the global [n_pad] layout
+            from jax.sharding import PartitionSpec as P
+
+            local_pad = n_pad // jax.process_count()
+            leaf = np.asarray(leaf)
+            if leaf.shape[0] != local_pad:
+                leaf = np.pad(leaf, ((0, local_pad - leaf.shape[0]), (0, 0)))
+            leaf = session._put(leaf, P("data", None))
+        elif leaf.shape[0] != n_pad:  # mesh padding: align with session rows
             leaf = jnp.pad(leaf, ((0, n_pad - leaf.shape[0]), (0, 0)))
         for i in range(len(forest.trees)):
             tree_contribs.append(leaf[:, i])
             tree_weights.append(1.0)
 
     evals_log = {}
+    _rows_cache = {}  # round-invariant global labels/weights (cox gather)
     stop = False
     for rnd in range(num_boost_round):
         # ---- sample dropout set -----------------------------------------
@@ -172,23 +207,31 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
         # ---- eval: dart predicts with the full (rescaled) forest ---------
         results = []
         if session.eval_sets:
-            for i, (name, dm, binned) in enumerate(session.eval_sets):
-                margin = (
-                    np.asarray(session.margins)[: session.n]
-                    if binned is session.train_binned
-                    else forest.predict_margin(dm.features)
-                )
-                preds = session.objective.margin_to_prediction(margin)
-                from . import eval_metrics
+            from .booster import evaluate_host_lines
 
-                for metric in metric_names:
-                    value = eval_metrics.evaluate(
-                        metric, preds, dm.labels, dm.weights, groups=dm.groups
+            # train margins come from the session (maintained under dart's
+            # rescaling); other sets re-predict with the mutated forest.
+            # _to_host returns this host's local rows in multi-process runs
+            # and evaluate_host_lines combines the lines across hosts.
+            results = evaluate_host_lines(
+                (
+                    (
+                        name,
+                        dm,
+                        session._to_host(session.margins, session.n)
+                        if binned is session.train_binned
+                        else forest.predict_margin(dm.features),
                     )
-                    results.append((name, metric, value))
-                if feval is not None:
-                    for metric_name, value in feval(margin, dm):
-                        results.append((name, metric_name, value))
+                    for name, dm, binned in session.eval_sets
+                ),
+                metric_names,
+                feval,
+                session.objective,
+                session.num_group,
+                config.objective_params,
+                session.is_multiprocess,
+                global_rows_cache=_rows_cache,
+            )
         for data_name, metric_name, value in results:
             evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
 

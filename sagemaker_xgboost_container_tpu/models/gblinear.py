@@ -145,7 +145,6 @@ def train_linear(
     psum across the axis, so every device runs identical weight updates
     (the reference trains gblinear under Rabit the same way: allreduced
     gradient sums in libxgboost's linear updater)."""
-    from . import eval_metrics
     from .booster import _eval_metric_names
 
     callbacks = list(callbacks or [])
@@ -156,17 +155,22 @@ def train_linear(
     n, d = dtrain.num_row, dtrain.num_col
     x_host = np.nan_to_num(dtrain.features, nan=0.0)  # linear path: missing = 0
 
-    if mesh is not None:
-        import jax as _jax
-
-        if _jax.process_count() > 1:
-            # checked before the axis-name test: a multi-process run with any
-            # mesh must refuse loudly, never fall through to per-host models
-            raise exc.UserError(
-                "booster=gblinear does not support multi-process distributed "
-                "training yet; run single-host (multi-device meshes within "
-                "one host are supported)."
-            )
+    # multi-process: each host holds its own row shard; arrays assemble into
+    # global arrays over the whole mesh (the same contract as the tree
+    # booster — reference parity: libxgboost's linear updater allreduces its
+    # gradient sums under Rabit exactly like hist does). Anything other
+    # than a cross-host data mesh would silently train divergent per-host
+    # models — refuse loudly.
+    is_multiproc = jax.process_count() > 1
+    if is_multiproc and (
+        mesh is None
+        or "data" not in getattr(mesh, "axis_names", ())
+        or int(mesh.shape["data"]) <= 1
+    ):
+        raise exc.UserError(
+            "Multi-process booster=gblinear training requires a mesh with a "
+            "'data' axis spanning the hosts."
+        )
 
     n_shards = 1
     axis = None
@@ -186,7 +190,22 @@ def train_linear(
 
     from .booster import _pad_rows
 
-    n_pad = -(-n // n_shards) * n_shards
+    # pad divisor: LOCAL data shards in a multi-process run (each host lays
+    # out only its own rows); whole-mesh data shards otherwise
+    pad_unit = (
+        max(1, int(mesh.local_mesh.shape["data"])) if is_multiproc else n_shards
+    )
+    n_pad = -(-n // pad_unit) * pad_unit
+    if is_multiproc:
+        # hosts may hold UNEVEN row counts: agree on one local padded size
+        # so the assembled global array has uniform device shards
+        from jax.experimental import multihost_utils
+
+        n_pad = int(
+            np.asarray(
+                multihost_utils.process_allgather(np.asarray([n_pad], np.int64))
+            ).max()
+        )
     if n_pad != n:
         # zero-weight padding rows: contribute nothing to any psum'd stat
         x_host = _pad_rows(x_host, n_pad, 0.0)
@@ -201,7 +220,12 @@ def train_linear(
         from jax.sharding import PartitionSpec as P
 
         def put(arr, spec):
-            return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+            sharding = NamedSharding(mesh, spec)
+            if is_multiproc:
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(arr)
+                )
+            return jax.device_put(jnp.asarray(arr), sharding)
 
         x = put(x_host, P("data", None))
         xT = put(xT_host, P(None, "data"))
@@ -315,6 +339,27 @@ def train_linear(
     )
     metric_names = _eval_metric_names(config, objective)
 
+    _rows_cache = {}
+
+    def _eval_round():
+        """One round's metric lines: host evaluation with the shared
+        cross-host combine (identical lines on every host — same semantics
+        as the tree booster's evaluate())."""
+        from .booster import evaluate_host_lines
+
+        results = evaluate_host_lines(
+            ((name, dm, model.predict_margin(dm.features)) for dm, name in evals),
+            metric_names,
+            feval,
+            objective,
+            G,
+            config.objective_params,
+            is_multiproc,
+            global_rows_cache=_rows_cache,
+        )
+        for name, metric, value in results:
+            evals_log.setdefault(name, {}).setdefault(metric, []).append(value)
+
     model.rounds = start_round
     evals_log = {}
     stop = False
@@ -323,23 +368,7 @@ def train_linear(
         model.weights = np.asarray(w)
         model.bias = np.asarray(b)
         model.rounds = rnd + 1
-        for dm, name in evals:
-            margin = model.predict_margin(dm.features)
-            preds = objective.margin_to_prediction(margin)
-            prob_matrix = None
-            if G > 1:
-                prob_matrix = objectives_mod.SoftprobMulti.margin_to_prediction(
-                    objective, margin
-                )
-            for metric in metric_names:
-                value = eval_metrics.evaluate(
-                    metric, preds, dm.labels, dm.weights,
-                    groups=dm.groups, prob_matrix=prob_matrix,
-                )
-                evals_log.setdefault(name, {}).setdefault(metric, []).append(value)
-            if feval is not None:
-                for metric_name, value in feval(margin, dm):
-                    evals_log.setdefault(name, {}).setdefault(metric_name, []).append(value)
+        _eval_round()
         for cb in callbacks:
             if hasattr(cb, "after_iteration") and cb.after_iteration(model, rnd, evals_log):
                 stop = True

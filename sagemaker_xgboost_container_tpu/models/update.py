@@ -31,8 +31,6 @@ import numpy as np
 
 from ..ops.predict import forest_leaf_nodes
 from ..toolkit import exceptions as exc
-from . import eval_metrics
-from . import objectives as objectives_mod
 
 
 def _node_depth_order(tree):
@@ -57,14 +55,19 @@ def _score(g, h, reg_lambda, alpha):
     return (t * t) / (h + reg_lambda)
 
 
-def _refresh_tree(tree, leaf_of_row, g, h, config, refresh_leaf):
+def _refresh_tree(tree, leaf_of_row, g, h, config, refresh_leaf, combine=None):
     """Rebuild node stats from rows routed to each leaf; returns the tree's
-    per-row contribution after any leaf-value update."""
+    per-row contribution after any leaf-value update. ``combine`` (multi-
+    host) sums the per-leaf stats across processes — the refresh analog of
+    libxgboost TreeRefresher's rabit allreduce of node stats."""
     n_nodes = tree.num_nodes
     G = np.zeros(n_nodes, np.float64)
     H = np.zeros(n_nodes, np.float64)
     np.add.at(G, leaf_of_row, g)
     np.add.at(H, leaf_of_row, h)
+    if combine is not None:
+        GH = combine(np.stack([G, H]))
+        G, H = GH[0], GH[1]
     order, _depth = _node_depth_order(tree)
     for node in order:  # children accumulate into parents (deepest first)
         p = tree.parent[node]
@@ -114,7 +117,7 @@ def _prune_tree(tree, gamma, eta):
     return pruned
 
 
-def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_round):
+def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_round, mesh=None):
     """Apply refresh/prune updaters to ``forest`` over ``dtrain``."""
     updaters = [
         u.strip()
@@ -134,13 +137,33 @@ def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_roun
         )
     import jax
 
+    # multi-host: each host routes its own row shard; per-node (sum_g,
+    # sum_h) combine across hosts before the refresh/prune math, so every
+    # host applies identical updates (reference parity: libxgboost's
+    # TreeRefresher allreduces node stats under Rabit — with replicated
+    # channels rows count once per host there too). Requires the cross-host
+    # data mesh as the sharding signal; a multi-process run without one
+    # would silently refresh divergent per-host models, so refuse loudly.
+    # Transport is f32 (x64 is off), summation host-side in f64 — same
+    # policy as the metric combine.
+    combine = None
     if jax.process_count() > 1:
-        # node stats here are host-local numpy; multi-host shards would
-        # silently produce a different model per host
-        raise exc.UserError(
-            "process_type='update' does not support multi-process distributed "
-            "training yet; run the update job single-host."
-        )
+        if (
+            mesh is None
+            or "data" not in getattr(mesh, "axis_names", ())
+            or int(mesh.shape["data"]) <= 1
+        ):
+            raise exc.UserError(
+                "Multi-process process_type='update' requires a mesh with a "
+                "'data' axis spanning the hosts."
+            )
+        from jax.experimental import multihost_utils
+
+        def combine(stats):
+            return np.asarray(
+                multihost_utils.process_allgather(stats.astype(np.float32)),
+                np.float64,
+            ).sum(axis=0)
 
     objective = forest.objective()
     objective.validate_labels(dtrain.labels)
@@ -161,6 +184,7 @@ def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_roun
 
     metric_names = _eval_metric_names(config, objective)
     evals_log = {}
+    _rows_cache = {}  # round-invariant global labels/weights (cox gather)
     stop = False
     for rnd in range(rounds):
         g, h = objective.grad_hess(margins, labels, weights)
@@ -179,6 +203,7 @@ def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_roun
             _refresh_tree(
                 tree, leaf_nodes[:, j], g_c, h_c, config,
                 refresh_leaf and "refresh" in updaters,
+                combine=combine,
             )
             if "prune" in updaters:
                 _prune_tree(tree, config.gamma, config.eta)
@@ -194,20 +219,28 @@ def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_roun
             else:
                 margins[:, forest.tree_info[t]] += contrib
 
-        results = []
-        for dm, name in evals:
-            margin = forest.predict_margin(
-                np.asarray(dm.features, np.float32), iteration_range=(0, rnd + 1)
-            )
-            preds = objective.margin_to_prediction(margin)
-            for metric in metric_names:
-                value = eval_metrics.evaluate(
-                    metric, preds, dm.labels, dm.weights, groups=dm.groups
+        from .booster import evaluate_host_lines
+
+        results = evaluate_host_lines(
+            (
+                (
+                    name,
+                    dm,
+                    forest.predict_margin(
+                        np.asarray(dm.features, np.float32),
+                        iteration_range=(0, rnd + 1),
+                    ),
                 )
-                results.append((name, metric, value))
-            if feval is not None:
-                for metric_name, value in feval(margin, dm):
-                    results.append((name, metric_name, value))
+                for dm, name in evals
+            ),
+            metric_names,
+            feval,
+            objective,
+            G_out,
+            config.objective_params,
+            combine is not None,
+            global_rows_cache=_rows_cache,
+        )
         for data_name, metric_name, value in results:
             evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
         for cb in callbacks:

@@ -240,6 +240,174 @@ def cox_metrics_worker(rank, world, port, q):
     q.put((rank, dev_log, host_log, check))
 
 
+def gblinear_worker(rank, world, port, q):
+    """2-process pod training booster=gblinear (r4 parity lift): coordinate
+    descent with psum'd sufficient statistics across hosts — previously a
+    UserError. UNEVEN shards (301 vs 299); watchlist lines must be identical
+    across hosts and the weights must match a single-device oracle over the
+    combined rows."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world,
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(7)
+    n = 600
+    X = rng.randn(n, 5).astype(np.float32)
+    beta = np.asarray([1.0, -2.0, 0.5, 0.0, 3.0], np.float32)
+    y = (X @ beta + 0.1 * rng.randn(n)).astype(np.float32)
+    lo, hi = (0, 301) if rank == 0 else (301, n)
+    dtrain = DataMatrix(X[lo:hi], labels=y[lo:hi])
+    mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+
+    log = {}
+
+    class Rec:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update(
+                {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+            )
+            return False
+
+    params = {"booster": "gblinear", "eta": 0.5, "reg_lambda": 0.1, "eval_metric": "rmse"}
+    model = train(
+        params, dtrain, num_boost_round=20,
+        evals=[(dtrain, "train")], callbacks=[Rec()], mesh=mesh,
+    )
+    preds = np.asarray(model.predict(X[:32]))
+    q.put((rank, preds, log["train"]["rmse"]))
+
+
+def dart_worker(rank, world, port, q):
+    """2-process pod training booster=dart (r4 parity lift): per-round
+    dropout draws ride the shared seed so hosts drop identical trees; the
+    GSPMD-partitioned builder psums histograms. Both hosts must produce
+    identical predictions and watchlist lines."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world,
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(3)
+    n = 800
+    X = rng.rand(n, 4).astype(np.float32)
+    y = (3 * X[:, 0] + np.sin(5 * X[:, 1])).astype(np.float32)
+    lo, hi = (0, 401) if rank == 0 else (401, n)  # uneven shards
+    dtrain = DataMatrix(X[lo:hi], labels=y[lo:hi])
+    mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+
+    log = {}
+
+    class Rec:
+        def after_iteration(self, model, epoch, evals_log):
+            log.update(
+                {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+            )
+            return False
+
+    params = {
+        "booster": "dart",
+        "max_depth": 3,
+        "eta": 0.3,
+        "seed": 5,
+        "rate_drop": 0.3,
+        "eval_metric": "rmse",
+    }
+    model = train(
+        params, dtrain, num_boost_round=8,
+        evals=[(dtrain, "train")], callbacks=[Rec()], mesh=mesh,
+    )
+    preds = np.asarray(model.predict(X[:32]))
+    q.put((rank, preds, log["train"]["rmse"]))
+
+
+def update_worker(rank, world, port, q):
+    """2-process pod running process_type=update (r4 parity lift): each host
+    routes its own UNEVEN row shard through the base model; per-node stats
+    allgather-sum so both hosts refresh/prune to identical trees — and they
+    must equal a single-device update over the combined rows."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world,
+        process_id=rank,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(9)
+    n = 600
+    X = rng.rand(n, 4).astype(np.float32)
+    y = (3 * X[:, 0] + np.sin(5 * X[:, 1])).astype(np.float32)
+    # identical base model on every host (same full data + seed, no mesh)
+    base = train(
+        {"max_depth": 4, "eta": 0.3, "seed": 1, "gamma": 0.0},
+        DataMatrix(X, labels=y),
+        num_boost_round=4,
+    )
+    # fresh rows for the update job, sharded UNEVENLY across the hosts; the
+    # mesh is the required sharding signal for the cross-host stat combine
+    mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+    X2 = rng.rand(500, 4).astype(np.float32)
+    y2 = (3 * X2[:, 0] + np.sin(5 * X2[:, 1])).astype(np.float32)
+    lo, hi = (0, 251) if rank == 0 else (251, 500)
+    refreshed = train(
+        {
+            "max_depth": 4,
+            "eta": 0.3,
+            "process_type": "update",
+            "updater": "refresh,prune",
+            "gamma": 0.1,
+            "eval_metric": "rmse",
+        },
+        DataMatrix(X2[lo:hi], labels=y2[lo:hi]),
+        num_boost_round=4,
+        evals=[(DataMatrix(X2[lo:hi], labels=y2[lo:hi]), "train")],
+        xgb_model=base,
+        mesh=mesh,
+    )
+    preds = np.asarray(refreshed.predict(X2[:32]))
+    q.put((rank, preds))
+
+
 def host_loss_worker(rank, world, port, q):
     """2-process pod where rank 1 dies mid-train (simulated host loss /
     preemption). Contract under test (VERDICT r2 missing #5): the SURVIVOR

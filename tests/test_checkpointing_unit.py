@@ -1,0 +1,270 @@
+"""In-process unit tier for checkpointing / CV prediction recorder /
+callback assembly — the reference's test_checkpointing.py and
+prediction-recorder unit tests (SURVEY §4) driven without subprocesses
+(the e2e tier exercises the same code through the real entrypoint, which
+in-process coverage measurement cannot see)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+from sagemaker_xgboost_container_tpu.training import checkpointing
+from sagemaker_xgboost_container_tpu.training.callbacks import (
+    EarlyStopping,
+    EvaluationMonitor,
+    get_callbacks,
+)
+from sagemaker_xgboost_container_tpu.training.prediction_utils import (
+    PREDICTIONS_OUTPUT_FILE,
+    ValidationPredictionRecorder,
+)
+
+
+class FakeModel:
+    """Minimal save_model() contract (a serialized booster stand-in)."""
+
+    def __init__(self, tag="m"):
+        self.tag = tag
+        self.attributes = {}
+
+    def save_model(self, path):
+        with open(path, "w") as f:
+            f.write(self.tag)
+
+
+# --------------------------------------------------------------- load/resume
+
+
+def test_load_checkpoint_missing_dir(tmp_path):
+    assert checkpointing.load_checkpoint(None) == (None, 0)
+    assert checkpointing.load_checkpoint(str(tmp_path / "absent")) == (None, 0)
+
+
+def test_load_checkpoint_picks_highest_iteration(tmp_path):
+    for it in (0, 3, 11):
+        (tmp_path / "xgboost-checkpoint.{}".format(it)).write_text("x")
+    (tmp_path / "unrelated.file").write_text("x")
+    path, nxt = checkpointing.load_checkpoint(str(tmp_path))
+    assert path.endswith("xgboost-checkpoint.11")
+    assert nxt == 12  # resume continues with num_round - 12 remaining
+
+
+# ----------------------------------------------------------------- retention
+
+
+def _run_rounds(cb, rounds, start=0):
+    m = FakeModel()
+    for epoch in range(start, start + rounds):
+        cb.after_iteration(m, epoch, {})
+    cb.after_training(m)
+
+
+def _checkpoints(tmp_path):
+    return sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("xgboost-checkpoint.")
+    )
+
+
+def test_checkpoint_rotation_keeps_newest(tmp_path):
+    cb = checkpointing.SaveCheckpointCallBack(str(tmp_path), max_to_keep=3)
+    _run_rounds(cb, 10)
+    kept = _checkpoints(tmp_path)
+    assert kept == ["xgboost-checkpoint.7", "xgboost-checkpoint.8", "xgboost-checkpoint.9"]
+    # atomic writes leave no temp files behind
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".sagemaker-ignore")]
+
+
+def test_checkpoint_rotation_spares_preexisting_files(tmp_path):
+    (tmp_path / "xgboost-checkpoint.0").write_text("from a previous job")
+    cb = checkpointing.SaveCheckpointCallBack(str(tmp_path), max_to_keep=2)
+    _run_rounds(cb, 6, start=1)
+    kept = _checkpoints(tmp_path)
+    # pre-existing checkpoint 0 is never deleted (previous_checkpoints set)
+    assert "xgboost-checkpoint.0" in kept
+    assert "xgboost-checkpoint.5" in kept and "xgboost-checkpoint.6" in kept
+
+
+def test_checkpoint_deleter_defers_uploading_marker(tmp_path):
+    cb = checkpointing.SaveCheckpointCallBack(str(tmp_path), max_to_keep=1)
+    m = FakeModel()
+    cb.after_iteration(m, 0, {})
+    # SageMaker "still uploading" lock on checkpoint 0
+    lock = str(tmp_path / "xgboost-checkpoint.0.sagemaker-uploading")
+    open(lock, "w").close()
+    cb.after_iteration(m, 1, {})
+    cb.after_iteration(m, 2, {})
+    deadline = time.time() + 5
+    while time.time() < deadline and "xgboost-checkpoint.1" in _checkpoints(tmp_path):
+        time.sleep(0.05)
+    kept = _checkpoints(tmp_path)
+    assert "xgboost-checkpoint.0" in kept, "locked file must be deferred"
+    assert "xgboost-checkpoint.1" not in kept, "unlocked stale file deleted"
+    # upload finishes -> the safe marker releases the lock; the final drain
+    # (after_training) may then remove the stale checkpoint
+    open(lock.replace(".sagemaker-uploading", ".sagemaker-uploaded"), "w").close()
+    cb.after_training(m)
+    assert "xgboost-checkpoint.2" in _checkpoints(tmp_path)
+
+
+def test_intermediate_model_master_only(tmp_path):
+    master = checkpointing.SaveIntermediateModelCallBack(
+        str(tmp_path / "a"), "xgboost-model", is_master=True
+    )
+    worker = checkpointing.SaveIntermediateModelCallBack(
+        str(tmp_path / "b"), "xgboost-model", is_master=False
+    )
+    m = FakeModel()
+    master.after_iteration(m, 0, {})
+    worker.after_iteration(m, 0, {})
+    assert (tmp_path / "a" / "xgboost-model").exists()
+    assert not (tmp_path / "b" / "xgboost-model").exists()
+
+
+# --------------------------------------------------- prediction recorder (CV)
+
+
+def test_recorder_regression_mean(tmp_path):
+    y = np.asarray([1.0, 2.0, 3.0, 4.0])
+    rec = ValidationPredictionRecorder(y, 2, classification=False,
+                                       output_data_dir=str(tmp_path))
+    for repeat in range(2):
+        rec.record(np.asarray([0, 1]), np.asarray([1.0 + repeat, 2.0 + repeat]))
+        rec.record(np.asarray([2, 3]), np.asarray([3.0 + repeat, 4.0 + repeat]))
+    rec.save()
+    out = np.loadtxt(tmp_path / PREDICTIONS_OUTPUT_FILE, delimiter=",")
+    np.testing.assert_allclose(out[:, 0], y)
+    np.testing.assert_allclose(out[:, 1], y + 0.5)  # mean over the 2 repeats
+
+
+def test_recorder_classification_mode_and_proba(tmp_path):
+    y = np.asarray([0.0, 1.0])
+    rec = ValidationPredictionRecorder(y, 3, classification=True,
+                                       output_data_dir=str(tmp_path))
+    # row 0 votes 0, 0, 1 -> mode 0; row 1 votes 1, 1, 0 -> mode 1
+    for p0, p1 in ((0.2, 0.9), (0.4, 0.8), (0.7, 0.3)):
+        rec.record(np.asarray([0, 1]), np.asarray([p0, p1]))
+    rec.save()
+    out = np.loadtxt(tmp_path / PREDICTIONS_OUTPUT_FILE, delimiter=",")
+    # %f in the csv keeps 6 decimals
+    np.testing.assert_allclose(
+        out[:, 1], [(0.2 + 0.4 + 0.7) / 3, (0.9 + 0.8 + 0.3) / 3], atol=1e-6
+    )
+    np.testing.assert_allclose(out[:, 2], [0.0, 1.0])
+
+
+def test_recorder_multiclass_argmax(tmp_path):
+    y = np.asarray([2.0, 0.0])
+    rec = ValidationPredictionRecorder(y, 1, classification=True,
+                                       output_data_dir=str(tmp_path))
+    rec.record(
+        np.asarray([0, 1]),
+        np.asarray([[0.1, 0.2, 0.7], [0.8, 0.1, 0.1]]),
+    )
+    rec.save()
+    out = np.loadtxt(tmp_path / PREDICTIONS_OUTPUT_FILE, delimiter=",")
+    np.testing.assert_allclose(out[:, 2], [2.0, 0.0])   # argmax labels
+    np.testing.assert_allclose(out[:, 1], [0.7, 0.8])   # winning proba
+
+
+def test_recorder_rejects_extra_and_incomplete(tmp_path):
+    rec = ValidationPredictionRecorder(
+        np.zeros(2), 1, classification=False, output_data_dir=str(tmp_path)
+    )
+    rec.record(np.asarray([0]), np.asarray([1.0]))
+    with pytest.raises(exc.AlgorithmError, match="repeated predictions"):
+        rec.record(np.asarray([0]), np.asarray([1.0]))
+    with pytest.raises(exc.AlgorithmError, match="not 1"):
+        rec.save()  # row 1 never recorded
+
+
+def test_recorder_rejects_ndim_switch(tmp_path):
+    rec = ValidationPredictionRecorder(
+        np.zeros(4), 2, classification=True, output_data_dir=str(tmp_path)
+    )
+    rec.record(np.asarray([0, 1]), np.asarray([0.1, 0.9]))
+    with pytest.raises(exc.AlgorithmError, match="ndim"):
+        rec.record(np.asarray([2, 3]), np.asarray([[0.1, 0.9], [0.8, 0.2]]))
+
+
+# ------------------------------------------------------------------ callbacks
+
+
+def test_get_callbacks_assembly_and_resume(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "xgboost-checkpoint.4").write_text("x")
+    xgb_model, iteration, cbs = get_callbacks(
+        model_dir=str(tmp_path / "model"),
+        checkpoint_dir=str(ckpt),
+        early_stopping_data_name="validation",
+        early_stopping_metric="auc",
+        early_stopping_rounds=3,
+        save_model_on_termination="false",
+        is_master=True,
+        num_round=10,
+    )
+    assert xgb_model.endswith("xgboost-checkpoint.4") and iteration == 5
+    kinds = [type(cb).__name__ for cb in cbs]
+    assert kinds[0] == "EvaluationMonitor"
+    assert "SaveCheckpointCallBack" in kinds
+    es = [cb for cb in cbs if isinstance(cb, EarlyStopping)][0]
+    assert es.maximize is True  # auc maximizes
+    for cb in cbs:
+        if hasattr(cb, "stop"):
+            cb.stop()
+
+
+def test_get_callbacks_worker_gets_no_savers(tmp_path):
+    _m, _it, cbs = get_callbacks(
+        model_dir=str(tmp_path),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        early_stopping_data_name=None,
+        early_stopping_metric=None,
+        early_stopping_rounds=None,
+        save_model_on_termination="true",
+        is_master=False,
+    )
+    kinds = [type(cb).__name__ for cb in cbs]
+    assert "SaveCheckpointCallBack" not in kinds
+    assert "SaveIntermediateModelCallBack" not in kinds
+
+
+def test_evaluation_monitor_hpo_line_format(capsys):
+    mon = EvaluationMonitor()
+    mon.after_iteration(
+        None, 7, {"train": {"rmse": [3.0, 2.5]}, "validation": {"rmse": [3.2, 2.75]}}
+    )
+    line = capsys.readouterr().out.strip()
+    # the load-bearing HPO scrape format (regex from algorithm/metrics.py)
+    import re
+
+    assert re.match(r"^\[7\]\ttrain-rmse:2\.50000\tvalidation-rmse:2\.75000$", line)
+
+
+def test_early_stopping_truncates_to_best():
+    class FakeForest:
+        def __init__(self):
+            self.trees = list(range(6))       # 1 tree per round, 6 rounds
+            self.tree_info = [0] * 6
+            self.iteration_indptr = list(range(7))
+            self.attributes = {}
+            self._stacked_cache = None
+
+    es = EarlyStopping(rounds=2, data_name="validation", metric_name="rmse",
+                       maximize=False, save_best=True)
+    series = [3.0, 2.0, 2.5, 2.6]  # best at epoch 1
+    log = {"validation": {"rmse": []}}
+    stopped = False
+    for epoch, v in enumerate(series):
+        log["validation"]["rmse"].append(v)
+        if es.after_iteration(None, epoch, log):
+            stopped = True
+            break
+    assert stopped
+    f = FakeForest()
+    es.after_training(f)
+    assert f.attributes["best_iteration"] == "1"
+    assert len(f.trees) == 2  # rounds 0..best inclusive

@@ -242,6 +242,10 @@ def _probe_matrix(deadline):
             "pallas,totals=pallas",
             dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_TOTALS_IMPL="pallas"),
         ),
+        (
+            "pallas,totals=onehot",
+            dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_TOTALS_IMPL="onehot"),
+        ),
     ]
     note = "no probe succeeded"
     best_label, best_env, best_value = None, None, -1.0
@@ -298,11 +302,19 @@ def _probe_matrix(deadline):
             ("pallas,vnodes=0", "GRAFT_HIST_VNODES", "0"),
             ("pallas,prec=bf16", "GRAFT_HIST_MM_PREC", "bf16"),
             ("pallas,route=onehot", "GRAFT_ROUTE_IMPL", "onehot"),
-            ("pallas,totals=pallas", "GRAFT_TOTALS_IMPL", "pallas"),
         ]:
             if results.get(label, 0.0) > base_v * 1.03:
                 composed[key] = val
                 parts.append(label.split(",", 1)[1])
+        # totals is ONE knob with two candidate lowerings: compose the
+        # better of the two when it beats the segment baseline
+        totals_best = max(
+            ("pallas,totals=onehot", "pallas,totals=pallas"),
+            key=lambda l: results.get(l, 0.0),
+        )
+        if results.get(totals_best, 0.0) > base_v * 1.03:
+            composed["GRAFT_TOTALS_IMPL"] = totals_best.rsplit("=", 1)[1]
+            parts.append(totals_best.split(",", 1)[1])
         if len(parts) > 1:
             best_label, best_env = "+".join(parts), composed
     return best_label, best_env, best_value, results, dict(configs), note

@@ -121,12 +121,19 @@ def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
     [W, d, B] histogram there removes the widest (most expensive) level from
     every tree build.
 
-    Two lowerings via ``GRAFT_TOTALS_IMPL``: ``segment`` (default) uses
-    segment_sum (sorted scatter-add on TPU — sorts all n rows by node id);
-    ``onehot`` scans row chunks and contracts a node one-hot on the MXU,
-    avoiding the sort entirely (same trick as the matmul histograms).
+    Three lowerings via ``GRAFT_TOTALS_IMPL``: ``segment`` uses segment_sum
+    (a sorted scatter-add on TPU — sorts all n rows by node id; fast on
+    CPU); ``onehot`` scans row chunks and contracts a node one-hot on the
+    MXU, avoiding the sort entirely (same trick as the matmul histograms);
+    ``pallas`` is the VMEM-resident VPU reduction. Default is backend-aware
+    like ``_impl``: scatter lowerings are the measured pathology on TPU
+    (flat-vs-pallas histograms: 12x), so TPU defaults to ``onehot`` and
+    everything else to ``segment`` — the env var overrides either way and
+    the bench probe battery A/Bs all three.
     """
-    impl = os.environ.get("GRAFT_TOTALS_IMPL", "segment")
+    impl = os.environ.get("GRAFT_TOTALS_IMPL")
+    if not impl:
+        impl = "onehot" if jax.default_backend() == "tpu" else "segment"
     if impl == "onehot":
         g_tot, h_tot = _totals_onehot(grad, hess, node_local, num_nodes)
     elif impl == "pallas":

@@ -59,8 +59,13 @@ EOF
 run 1500 dissect_pallas.log GRAFT_HIST_IMPL=pallas python scripts/dissect.py
 run 1200 dissect_novnodes.log GRAFT_HIST_IMPL=pallas GRAFT_HIST_VNODES=0 python scripts/dissect.py
 run 1200 dissect_onehot.log GRAFT_HIST_IMPL=pallas GRAFT_ROUTE_IMPL=onehot GRAFT_TOTALS_IMPL=pallas python scripts/dissect.py
+# the TPU default flipped to totals=onehot in r4: pin totals=segment once so
+# the r2-suspect segment_sum stage is still observable/attributable on chip
+run 1200 dissect_totals_segment.log GRAFT_HIST_IMPL=pallas GRAFT_TOTALS_IMPL=segment python scripts/dissect.py
 run 900 bench_serve.log python bench_serve.py
-run 1800 bench_reprobe.log BENCH_REPROBE=1 python bench.py
+# BENCH_TIMEOUT_S grown with the 8-probe matrix (147s/probe cap vs 97s at
+# the 1200 default) — still inside the 1800s external timeout
+run 1800 bench_reprobe.log BENCH_REPROBE=1 BENCH_TIMEOUT_S=1600 python bench.py
 run 1500 bench_multiclass.log GRAFT_HIST_IMPL=pallas BENCH_TASK=multiclass python bench.py
 run 1500 bench_ranking.log GRAFT_HIST_IMPL=pallas BENCH_TASK=ranking python bench.py
 # leaf-wise at LightGBM scale (VERDICT r3 #7): smaller row count + few

@@ -212,6 +212,29 @@ def test_monotone_constraint_enforced():
     assert (np.diff(preds) >= -1e-5).all()
 
 
+def test_monotone_constraint_enforced_lossguide():
+    """Monotonicity must hold under best-first growth too (the constraint
+    threads through every candidate-store refresh)."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(1500, 1).astype(np.float32)
+    y = (np.sin(X[:, 0] * 6) + X[:, 0]).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    forest = train(
+        {
+            "grow_policy": "lossguide",
+            "max_leaves": 16,
+            "max_depth": 0,
+            "monotone_constraints": (1,),
+            "tree_method": "hist",
+        },
+        dtrain,
+        num_boost_round=10,
+    )
+    grid = np.linspace(0, 1, 200, dtype=np.float32).reshape(-1, 1)
+    preds = forest.predict(grid)
+    assert (np.diff(preds) >= -1e-5).all()
+
+
 def test_subsample_and_colsample_still_learn():
     X, y = _friedman(1500)
     dtrain = DataMatrix(X, labels=y)

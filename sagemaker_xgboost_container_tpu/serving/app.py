@@ -19,6 +19,7 @@ import json
 import logging
 import multiprocessing
 import os
+import threading
 
 from .. import constants
 from ..toolkit import exceptions as exc
@@ -48,22 +49,28 @@ class ScoringService:
         self.model = None
         self.model_format = None
         self._batcher = None
+        self._load_lock = threading.Lock()
 
     def load_model(self):
-        if self.model is None:
-            self.model, self.model_format = serve_utils.get_loaded_booster(
-                self.model_dir, serve_utils.is_ensemble_enabled()
-            )
-            if not isinstance(self.model, list) and os.getenv(
-                "SAGEMAKER_SERVING_BATCHING", "true"
-            ).lower() == "true":
-                from .batcher import PredictBatcher
-
-                model = self.model
-                rng = serve_utils.best_iteration_range(model)
-                self._batcher = PredictBatcher(
-                    lambda feats: model.predict(feats, iteration_range=rng)
+        # lock: concurrent first requests on the threaded server must not
+        # each load the model (and each spawn a warmup compile burst)
+        with self._load_lock:
+            if self.model is None:
+                self.model, self.model_format = serve_utils.get_loaded_booster(
+                    self.model_dir, serve_utils.is_ensemble_enabled()
                 )
+                if not isinstance(self.model, list) and os.getenv(
+                    "SAGEMAKER_SERVING_BATCHING", "true"
+                ).lower() == "true":
+                    from .batcher import PredictBatcher
+
+                    model = self.model
+                    rng = serve_utils.best_iteration_range(model)
+                    self._batcher = PredictBatcher(
+                        lambda feats: model.predict(feats, iteration_range=rng)
+                    )
+                # compile the first device buckets off the request path
+                serve_utils.warmup_predict_async(self.model)
         return self.model_format
 
     @property

@@ -93,6 +93,10 @@ class ModelManager:
             if self.max_models and len(self._models) > self.max_models:
                 evicted, _ = self._models.popitem(last=False)
                 logger.info("Evicted model %s (LRU cap %d)", evicted, self.max_models)
+        # compile the first device buckets off the request path — only for a
+        # model that actually registered (a duplicate-name load must not
+        # burn the single TPU compiling a discarded model)
+        serve_utils.warmup_predict_async(model)
 
     def unload(self, name):
         with self._lock:

@@ -18,6 +18,7 @@ xgboost Boosters (models/compat.py handles the foreign formats).
 
 import io
 import json
+import logging
 import os
 
 import numpy as np
@@ -176,6 +177,49 @@ def best_iteration_range(forest):
     if best_iteration is None:
         return None
     return (0, int(best_iteration) + 1)
+
+
+def warmup_predict_async(model):
+    """Pre-compile the first device predict buckets in the background.
+
+    Payloads at or below GRAFT_HOST_PREDICT_ROWS run the host numpy path
+    (never compile); the first request ABOVE it pays the XLA compile of its
+    row bucket — tens of seconds on a TPU endpoint, easily tripping client
+    timeouts right after deploy. Warming the smallest device bucket plus a
+    representative batch bucket at model-load time moves that cost off the
+    request path. Fire-and-forget daemon thread; failures only log.
+    GRAFT_PREDICT_WARMUP=0 disables."""
+    if os.getenv("GRAFT_PREDICT_WARMUP", "1") != "1":
+        return
+
+    def _warm():
+        try:
+            from ..models.forest import _host_predict_rows
+
+            t = _host_predict_rows()
+
+            def bucket(n):  # the power-of-two bucket predict_margin pads to
+                return max(8, 1 << (int(n - 1).bit_length()))
+
+            # distinct device buckets only: the smallest one past the host
+            # threshold plus a representative batch bucket (skipping sizes
+            # the host path would swallow, which compile nothing)
+            sizes = sorted({bucket(t + 1), bucket(max(256, t + 1))})
+            for m in model if isinstance(model, list) else [model]:
+                d = int(getattr(m, "num_feature", 0) or 0)
+                if d <= 0:
+                    continue
+                for n in sizes:
+                    m.predict(
+                        np.zeros((n, d), np.float32),
+                        iteration_range=best_iteration_range(m),
+                    )
+        except Exception as e:  # a failed warmup must never break serving
+            logging.getLogger(__name__).info("predict warmup skipped: %s", e)
+
+    import threading
+
+    threading.Thread(target=_warm, daemon=True, name="predict-warmup").start()
 
 
 def predict(model, model_format, dtest, input_content_type, objective=None):

@@ -646,3 +646,45 @@ class TestConcurrentServing:
                 np.testing.assert_allclose(out, expect, rtol=1e-4)
         finally:
             httpd.shutdown()
+
+
+def test_warmup_predict_async(abalone_model_dir):
+    """Model-load warmup: compiles the first device buckets off the request
+    path (TPU first-hit compile spike), never raises, and is inert for
+    degenerate models."""
+    model, _fmt = serve_utils.get_loaded_booster(abalone_model_dir)
+    serve_utils.warmup_predict_async(model)
+    threads = [t for t in threading.enumerate() if t.name == "predict-warmup"]
+    for t in threads:
+        t.join(timeout=120)
+    assert not [
+        t for t in threading.enumerate()
+        if t.name == "predict-warmup" and t.is_alive()
+    ]
+    # the warmed bucket serves correctly (beyond the host-path threshold)
+    n = 40
+    x = np.full((n, model.num_feature), 0.5, np.float32)
+    preds = model.predict(x)
+    assert preds.shape == (n,) and np.isfinite(np.asarray(preds)).all()
+
+    # degenerate model (no features): warmup skips without raising
+    class NoFeatures:
+        num_feature = 0
+
+    serve_utils.warmup_predict_async(NoFeatures())
+    for t in threading.enumerate():
+        if t.name == "predict-warmup":
+            t.join(timeout=30)
+
+    # kill-switch respected
+    os.environ["GRAFT_PREDICT_WARMUP"] = "0"
+    try:
+        before = {t.ident for t in threading.enumerate()}
+        serve_utils.warmup_predict_async(model)
+        started = [
+            t for t in threading.enumerate()
+            if t.name == "predict-warmup" and t.ident not in before
+        ]
+        assert not started
+    finally:
+        os.environ.pop("GRAFT_PREDICT_WARMUP", None)

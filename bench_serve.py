@@ -69,6 +69,13 @@ def main():
         ",".join("%.4f" % v for v in row) for row in X[:256]
     ).encode()
 
+    # trigger the model load, then let its background bucket warmup finish
+    # BEFORE timing — an in-flight compile would pollute the first leg
+    post(single)
+    for t in threading.enumerate():
+        if t.name == "predict-warmup":
+            t.join(timeout=300)
+
     # A/B the small-payload strategy: host numpy traversal (pinned to a
     # cutover that definitely includes 1 row) vs forcing the compiled device
     # kernel; the operator's own env value is restored for the batch leg

@@ -22,6 +22,13 @@ from ..toolkit import exceptions as exc
 from . import objectives as objectives_mod
 
 
+def predict_bucket(n):
+    """Power-of-two row bucket the device predict path pads to — the single
+    source of truth shared by predict_margin and the serving warmup (which
+    pre-compiles exactly these buckets)."""
+    return max(8, 1 << (int(n - 1).bit_length())) if n else 8
+
+
 def _host_predict_rows():
     """Row-count cutover below which prediction runs the numpy host path
     instead of the compiled device kernel (0 disables). Default 32: at that
@@ -308,7 +315,7 @@ class Forest:
             )
         # bucket the row count to a power of two so serving payloads of
         # varying size share jit-compiled kernels instead of recompiling
-        n_pad = max(8, 1 << (int(n - 1).bit_length())) if n else 8
+        n_pad = predict_bucket(n)
         if n_pad != n:
             features = np.concatenate(
                 [features, np.zeros((n_pad - n, features.shape[1]), np.float32)], axis=0

@@ -93,10 +93,13 @@ class ModelManager:
             if self.max_models and len(self._models) > self.max_models:
                 evicted, _ = self._models.popitem(last=False)
                 logger.info("Evicted model %s (LRU cap %d)", evicted, self.max_models)
-        # compile the first device buckets off the request path — only for a
-        # model that actually registered (a duplicate-name load must not
-        # burn the single TPU compiling a discarded model)
-        serve_utils.warmup_predict_async(model)
+            # compile the first device buckets off the request path — only
+            # for a model that survived registration AND the LRU eviction
+            # above (a discarded model must not burn the single TPU); the
+            # spawn rides inside the lock so a concurrent load can't evict
+            # it in between
+            if name in self._models:
+                serve_utils.warmup_predict_async(model)
 
     def unload(self, name):
         with self._lock:

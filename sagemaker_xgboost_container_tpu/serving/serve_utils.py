@@ -188,23 +188,20 @@ def warmup_predict_async(model):
     timeouts right after deploy. Warming the smallest device bucket plus a
     representative batch bucket at model-load time moves that cost off the
     request path. Fire-and-forget daemon thread; failures only log.
-    GRAFT_PREDICT_WARMUP=0 disables."""
-    if os.getenv("GRAFT_PREDICT_WARMUP", "1") != "1":
+    GRAFT_PREDICT_WARMUP=0 disables (any other value, including typos,
+    degrades to the default: enabled)."""
+    if os.getenv("GRAFT_PREDICT_WARMUP", "1").lower() in ("0", "false", "off", "no"):
         return
 
     def _warm():
         try:
-            from ..models.forest import _host_predict_rows
+            from ..models.forest import _host_predict_rows, predict_bucket
 
             t = _host_predict_rows()
-
-            def bucket(n):  # the power-of-two bucket predict_margin pads to
-                return max(8, 1 << (int(n - 1).bit_length()))
-
             # distinct device buckets only: the smallest one past the host
             # threshold plus a representative batch bucket (skipping sizes
             # the host path would swallow, which compile nothing)
-            sizes = sorted({bucket(t + 1), bucket(max(256, t + 1))})
+            sizes = sorted({predict_bucket(t + 1), predict_bucket(max(256, t + 1))})
             for m in model if isinstance(model, list) else [model]:
                 d = int(getattr(m, "num_feature", 0) or 0)
                 if d <= 0:

@@ -243,3 +243,33 @@ def test_abalone_binary_and_multiclass_train():
     )
     prob = forest.predict(dm_multi.features)
     assert prob.shape == (dm_multi.num_row, n_class)
+
+
+def test_check_data_redundancy(tmp_path, caplog):
+    """Reference data_utils.py:631-660: same-named same-size files across
+    train/validation warn (duplicate data impairs the validation score);
+    missing dirs raise UserError."""
+    import logging
+
+    from sagemaker_xgboost_container_tpu.data import readers
+    from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+    train = tmp_path / "train"
+    val = tmp_path / "validation"
+    train.mkdir()
+    val.mkdir()
+    (train / "part0").write_text("abcdef")
+    (val / "part0").write_text("uvwxyz")   # same name + size -> suspected dup
+    (train / "part1").write_text("123")
+    (val / "part1").write_text("12345")    # same name, size differs -> quiet
+    with caplog.at_level(logging.WARNING):
+        readers.check_data_redundancy(str(train), str(val))
+    assert "Suspected identical files" in caplog.text
+    assert "part0" in caplog.text and "part1" not in caplog.text
+
+    import pytest as _pytest
+
+    with _pytest.raises(exc.UserError, match="training data's path"):
+        readers.check_data_redundancy(str(tmp_path / "absent"), str(val))
+    with _pytest.raises(exc.UserError, match="validation data's path"):
+        readers.check_data_redundancy(str(train), str(tmp_path / "absent"))

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Render .tpuwatch/latest.json (the watcher battery's aggregate) as the
+BASELINE.md round table: one row per run with its headline numbers, plus
+the per-stage dissect comparison across knob configs.
+
+Usage: python scripts/tpuwatch_report.py [.tpuwatch/latest.json]
+"""
+
+import json
+import os
+import sys
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return "{:.3f}".format(v)
+    return str(v)
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(".tpuwatch", "latest.json")
+    with open(path) as f:
+        doc = json.load(f)
+    runs = doc.get("runs", {})
+    print("_updated: {}_".format(doc.get("updated", "?")))
+    print()
+
+    # --- bench-style runs: one row each -----------------------------------
+    bench_rows = []
+    for name, res in sorted(runs.items()):
+        if not res or "timings_ms" in res:
+            continue
+        headline = []
+        for key in ("value", "p50_single_row_ms_host", "p99_single_row_ms_host",
+                    "p50_single_row_ms_device", "p50_batch256_ms", "vs_baseline"):
+            if key in res:
+                headline.append("{}={}".format(key, _fmt(res[key])))
+        bench_rows.append((name, res.get("metric", "?"), "; ".join(headline)))
+    if bench_rows:
+        print("| Run | Metric | Result |")
+        print("|---|---|---|")
+        for name, metric, headline in bench_rows:
+            print("| {} | {} | {} |".format(name, metric, headline))
+        print()
+
+    # --- dissect runs: stages as rows, configs as columns -----------------
+    dissects = {
+        name: res["timings_ms"]
+        for name, res in runs.items()
+        if res and "timings_ms" in res
+    }
+    if dissects:
+        names = sorted(dissects)
+        stages = []
+        for t in dissects.values():
+            for s in t:
+                if s not in stages:
+                    stages.append(s)
+        print("| Stage (ms) | " + " | ".join(names) + " |")
+        print("|---|" + "---|" * len(names))
+        for s in stages:
+            cells = [
+                "{:.1f}".format(dissects[n][s]) if s in dissects[n] else "-"
+                for n in names
+            ]
+            print("| {} | ".format(s) + " | ".join(cells) + " |")
+        print()
+
+    missing = [n for n, r in sorted(runs.items()) if not r]
+    if missing:
+        print("_no parseable result:_ " + ", ".join(missing))
+
+
+if __name__ == "__main__":
+    main()

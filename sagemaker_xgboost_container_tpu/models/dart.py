@@ -14,6 +14,16 @@ forest: new *= eta/(1+eta), dropped *= 1/(1+eta).
 Per-tree train-row contributions are cached on device so "margins without D"
 is a subtraction, not a re-predict; dropped trees' cached contributions and
 host-side leaf values are rescaled in place (dart mutates history).
+
+Multi-class (num_class>1): the round builds one tree per class under the same
+per-class vmap the gbtree path uses (booster.py one_round), sharing one rng so
+feature-subset draws match across classes. The dropout unit is a whole
+boosting round — all classes drop the same historical rounds (shared-seed
+dropout) — so cached contributions are [n, num_class] and the round's
+normalization rescales every class's tree for a dropped round. The reference
+permits booster=dart with multi:softmax/softprob (its HP schema constrains
+only sample_type/normalize_type, hyperparameter_validation.py:272-276, and
+libxgboost's dart updater imposes no class restriction).
 """
 
 import logging
@@ -31,8 +41,6 @@ logger = logging.getLogger(__name__)
 
 
 def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round, mesh=None):
-    if config.num_class > 1:
-        raise exc.UserError("booster=dart with multi-class objectives is not supported yet.")
     # multi-process: rows shard across hosts exactly like the tree booster;
     # the jitted builder runs on the global arrays (GSPMD combines), eval
     # lines combine across hosts, dropout draws ride the shared seed so all
@@ -75,6 +83,10 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
     # semantically the same global program, so trees match single-device)
     session = _TrainingSession(config, dtrain, list(evals), forest, mesh=mesh)
     metric_names = _eval_metric_names(config, session.objective)
+    # class count follows the session's output-group count (the objective),
+    # not raw num_class: a single-output objective with num_class set keeps
+    # 1-D shapes everywhere, same as the gbtree path
+    nclass = session.num_group
 
     # build trees with unit shrinkage; dart applies its own scaling
     jit_kwargs = {}
@@ -88,9 +100,11 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
         from ..ops.tree_build import _TREE_FIELDS
 
         tree_spec = {k: NamedSharding(mesh, P()) for k in _TREE_FIELDS}
-        jit_kwargs["out_shardings"] = (tree_spec, NamedSharding(mesh, P("data")))
-    builder = jax.jit(
-        lambda bins, g, h, num_cuts, mask, rng: build_tree(
+        row_spec = P("data") if nclass == 1 else P("data", None)
+        jit_kwargs["out_shardings"] = (tree_spec, NamedSharding(mesh, row_spec))
+
+    def _build_one(bins, g, h, num_cuts, mask, rng):
+        return build_tree(
             bins, g, h, num_cuts,
             max_depth=config.max_depth,
             num_bins=session.train_binned.num_bins,
@@ -103,24 +117,37 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             feature_mask=mask,
             colsample_bylevel=config.colsample_bylevel,
             rng=rng,
-        ),
-        **jit_kwargs,
-    )
+        )
+
+    if nclass > 1:
+        # same per-class vmap as the gbtree path; the shared rng makes
+        # every class draw identical feature subsets
+        def _build(bins, g, h, num_cuts, mask, rng):
+            tree, row_out = jax.vmap(
+                lambda gc, hc: _build_one(bins, gc, hc, num_cuts, mask, rng)
+            )(g.T, h.T)
+            return tree, row_out.T
+    else:
+        _build = _build_one
+    builder = jax.jit(_build, **jit_kwargs)
     grad_fn = jax.jit(session.objective.grad_hess)
 
-    tree_contribs = []   # device [n] row contributions, current scaling
-    tree_weights = []    # current scale factor per tree (host floats)
+    tree_contribs = []   # device [n] ([n, C] multi-class) contributions, current scaling
+    tree_weights = []    # current scale factor per dropout unit (host floats)
+    unit_slices = []     # dropout unit -> (start, stop) into forest.trees
     rng = np.random.RandomState(config.seed)
+
+    n_pad = session.bins.shape[0]  # global padded rows
 
     if forest.trees:
         # checkpoint resume: dropout must cover the checkpoint's trees too, so
         # rebuild their per-row contributions (one stacked-kernel pass;
-        # categorical-aware for BYO xgboost checkpoints)
+        # categorical-aware for BYO xgboost checkpoints). The [n, T] matrix is
+        # staged on device ONCE; per-unit contributions are device slices.
         from ..ops.predict import forest_leaf_margins
 
         stacked = forest._stack(slice(0, len(forest.trees)))
         leaf = forest_leaf_margins(stacked, dtrain.features)  # [n_local, T]
-        n_pad = session.bins.shape[0]  # global padded rows
         if is_multiproc:
             # this host's rows -> its segment of the global [n_pad] layout
             from jax.sharding import PartitionSpec as P
@@ -132,9 +159,27 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             leaf = session._put(leaf, P("data", None))
         elif leaf.shape[0] != n_pad:  # mesh padding: align with session rows
             leaf = jnp.pad(leaf, ((0, n_pad - leaf.shape[0]), (0, 0)))
-        for i in range(len(forest.trees)):
-            tree_contribs.append(leaf[:, i])
-            tree_weights.append(1.0)
+        if nclass > 1:
+            # round-units: one [n, C] contribution per boosted round, columns
+            # placed by the stored class ids — a dropped unit removes the
+            # whole round across classes (shared-seed dropout)
+            indptr = forest.iteration_indptr
+            for i in range(len(indptr) - 1):
+                s0, s1 = int(indptr[i]), int(indptr[i + 1])
+                info = [int(c) for c in forest.tree_info[s0:s1]]
+                if info == list(range(nclass)):
+                    cols = leaf[:, s0:s1]
+                else:  # BYO layouts (e.g. parallel trees): one-hot matmul
+                    onehot = jax.nn.one_hot(jnp.asarray(info), nclass, dtype=leaf.dtype)
+                    cols = leaf[:, s0:s1] @ onehot
+                tree_contribs.append(cols)
+                tree_weights.append(1.0)
+                unit_slices.append((s0, s1))
+        else:
+            for i in range(leaf.shape[1]):
+                tree_contribs.append(leaf[:, i])
+                tree_weights.append(1.0)
+                unit_slices.append((i, i + 1))
 
     evals_log = {}
     _rows_cache = {}  # round-invariant global labels/weights (cox gather)
@@ -168,7 +213,10 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             mask = np.ones(d, np.float32)
         if config.subsample < 1.0:
             keep = (rng.uniform(size=session.bins.shape[0]) < config.subsample).astype(np.float32)
-            g, h = g * jnp.asarray(keep), h * jnp.asarray(keep)
+            kj = jnp.asarray(keep)
+            if nclass > 1:
+                kj = kj[:, None]
+            g, h = g * kj, h * kj
 
         tree, row_out = builder(
             session.bins, g, h, session.num_cuts, jnp.asarray(mask),
@@ -192,8 +240,11 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             tree_contribs[i] = tree_contribs[i] * old_scale
             tree_weights[i] *= old_scale
             margins = margins + tree_contribs[i]
-            # rescale the stored tree's leaves (dart mutates history)
-            forest.trees[i].value *= old_scale
+            # rescale the stored trees' leaves (dart mutates history); a
+            # multi-class unit covers the round's whole per-class tree group
+            s0, s1 = unit_slices[i]
+            for t in forest.trees[s0:s1]:
+                t.value *= old_scale
         forest._stacked_cache = None
         session.margins = margins
         tree_contribs.append(new_contrib)
@@ -202,7 +253,20 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
         tree_np = jax.tree_util.tree_map(np.asarray, tree)
         tree_np["leaf_value"] = tree_np["leaf_value"] * new_scale
         tree_np["base_weight"] = tree_np["base_weight"] * new_scale
-        forest.append_round([compact_padded_tree(tree_np, session.cuts)], [0])
+        if nclass > 1:
+            forest.append_round(
+                [
+                    compact_padded_tree(
+                        jax.tree_util.tree_map(lambda a: a[c], tree_np),
+                        session.cuts,
+                    )
+                    for c in range(nclass)
+                ],
+                list(range(nclass)),
+            )
+        else:
+            forest.append_round([compact_padded_tree(tree_np, session.cuts)], [0])
+        unit_slices.append((len(forest.trees) - nclass, len(forest.trees)))
 
         # ---- eval: dart predicts with the full (rescaled) forest ---------
         results = []

@@ -11,15 +11,75 @@ set -u
 cd "${GRAFT_REPO:-/root/repo}"
 OUT=.tpuwatch
 mkdir -p "$OUT"
-PROBE='import jax; print(jax.devices()); import jax.numpy as j; print((j.ones((128,128))@j.ones((128,128))).sum())'
+# Phased probe taxonomy (VERDICT r4 #1): stage 1 is a bare backend init
+# (jax.devices() only — no compile, no dispatch) so the artifact says WHAT is
+# broken: rc=124 on stage 1 => backend init itself hangs ("tunnel wedged");
+# a nonzero non-timeout rc => libtpu/plugin raised during init (captured
+# stderr tail); stage-2 failures with stage 1 ok => compile/execute path.
+INIT_PROBE='import jax; print(",".join(str(d) for d in jax.devices()))'
+COMPUTE_PROBE='import jax; import jax.numpy as j; print((j.ones((128,128))@j.ones((128,128))).sum())'
+
+probe_taxonomy() {  # one phased probe; appends a JSON line to probes.jsonl
+  local ts init compute err devices rc
+  ts=$(date +%Y-%m-%dT%H:%M:%S)
+  devices=$(timeout -k 15 60 python -c "$INIT_PROBE" 2>"$OUT/.probe_err"); rc=$?
+  err=""
+  if [ $rc -eq 0 ]; then init=ok
+  elif [ $rc -eq 124 ]; then init=hang; err="backend init (jax.devices) exceeded 60s — tunnel wedged"
+  else init=error; err=$(cat "$OUT/.probe_err"); fi
+  compute=skipped
+  if [ "$init" = ok ]; then
+    if timeout -k 15 75 python -c "$COMPUTE_PROBE" >/dev/null 2>"$OUT/.probe_err"; then
+      compute=ok
+    else
+      rc=$?
+      if [ $rc -eq 124 ]; then compute=hang; err="matmul dispatch exceeded 75s with backend init ok"
+      else compute=error; err=$(cat "$OUT/.probe_err"); fi
+    fi
+  fi
+  python - "$OUT" "$ts" "$init" "$compute" "$err" "$devices" <<'EOF'
+import json, os, sys
+out, ts, init, compute, err, devices = sys.argv[1:7]
+rec = {"t": ts, "init": init, "compute": compute}
+if init == "ok" and devices.strip():
+    rec["devices"] = devices.strip().splitlines()[-1][:200]
+if err.strip():
+    rec["err"] = err.strip().splitlines()[-1][:400]
+with open(os.path.join(out, "probes.jsonl"), "a") as f:
+    f.write(json.dumps(rec) + "\n")
+# rolling summary: driver-visible taxonomy even if the chip never recovers
+counts, first, last = {}, None, rec
+with open(os.path.join(out, "probes.jsonl")) as f:
+    for line in f:
+        try:
+            r = json.loads(line)
+        except ValueError:  # truncated append (crash/kill mid-write)
+            continue
+        key = r["init"] if r["init"] != "ok" else "init_ok_compute_" + r["compute"]
+        counts[key] = counts.get(key, 0) + 1
+        first = first or r
+doc = {"updated": ts, "probes": sum(counts.values()), "taxonomy": counts,
+       "first": first, "last": last}
+tmp = os.path.join(out, ".probe_summary.tmp")
+with open(tmp, "w") as f:
+    json.dump(doc, f, indent=1)
+os.replace(tmp, os.path.join(out, "probe_summary.json"))
+EOF
+  [ "$compute" = ok ]
+}
 
 echo "[watch] start $(date +%H:%M:%S)" >> "$OUT/watch.log"
+# rotate the probe record at start: the summary must describe THIS run's
+# outage, not accumulate prior rounds' probes (.tpuwatch persists)
+if [ -s "$OUT/probes.jsonl" ]; then
+  mv "$OUT/probes.jsonl" "$OUT/probes.prev.jsonl"
+fi
 while true; do
-  if timeout 75 python -c "$PROBE" >> "$OUT/watch.log" 2>&1; then
+  if probe_taxonomy; then
     echo "[watch] chip healthy $(date +%H:%M:%S)" >> "$OUT/watch.log"
     break
   fi
-  echo "[watch] still down $(date +%H:%M:%S)" >> "$OUT/watch.log"
+  echo "[watch] still down $(date +%H:%M:%S) ($(tail -n1 "$OUT/probes.jsonl"))" >> "$OUT/watch.log"
   sleep 150
 done
 

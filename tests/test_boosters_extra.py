@@ -88,6 +88,89 @@ def test_dart_with_dropout_learns():
     assert rmse < 0.35 * base, (rmse, base)
 
 
+def _blobs(n=900, c=3, seed=4):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    y = rng.randint(0, c, size=n).astype(np.float32)
+    X[:, 0] += 3.0 * y  # separable along feature 0
+    return X, y
+
+
+def test_dart_multiclass_learns():
+    """r5 guard lift: booster=dart with multi:softprob (per-class vmap,
+    shared-seed round-unit dropout). Reference permits this combination
+    (hyperparameter_validation.py:272-276 constrains only dart's own HPs)."""
+    X, y = _blobs()
+    model = train(
+        {
+            "booster": "dart",
+            "objective": "multi:softprob",
+            "num_class": 3,
+            "max_depth": 3,
+            "eta": 0.4,
+            "rate_drop": 0.2,
+            "one_drop": 1,
+            "seed": 11,
+        },
+        DataMatrix(X, labels=y),
+        num_boost_round=12,
+        evals=[(DataMatrix(X, labels=y), "train")],
+    )
+    # one tree per class per round
+    assert len(model.trees) == 36
+    assert model.tree_info[:3] == [0, 1, 2]
+    p = model.predict(X)  # softprob -> [n, 3]
+    assert p.shape == (X.shape[0], 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+    assert (p.argmax(axis=1) == y).mean() > 0.9
+
+
+def test_dart_multiclass_rate_drop_zero_matches_gbtree():
+    """With dropout off, the dart multi-class round is the gbtree per-class
+    vmap round with eta scaling — predictions must match."""
+    X, y = _blobs(500)
+    common = {"objective": "multi:softprob", "num_class": 3, "max_depth": 3, "eta": 0.3}
+    dart = train(
+        {"booster": "dart", "rate_drop": 0.0, **common},
+        DataMatrix(X, labels=y),
+        num_boost_round=5,
+    )
+    gbtree = train(
+        {"booster": "gbtree", **common},
+        DataMatrix(X, labels=y),
+        num_boost_round=5,
+    )
+    np.testing.assert_allclose(
+        dart.predict(X), gbtree.predict(X), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_dart_multiclass_resume(tmp_path):
+    """Checkpoint resume rebuilds round-unit [n, C] contributions from the
+    stored per-class trees so dropout covers the checkpoint's rounds too."""
+    X, y = _blobs(600, seed=9)
+    params = {
+        "booster": "dart",
+        "objective": "multi:softprob",
+        "num_class": 3,
+        "max_depth": 3,
+        "rate_drop": 0.3,
+        "one_drop": 1,
+        "seed": 5,
+    }
+    first = train(params, DataMatrix(X, labels=y), num_boost_round=4)
+    path = str(tmp_path / "xgboost-model")
+    first.save_model(path)
+    loaded, _fmt = load_model_any_format(path)
+    resumed = train(
+        params, DataMatrix(X, labels=y), num_boost_round=4, xgb_model=loaded
+    )
+    assert resumed.num_boosted_rounds == 8
+    assert len(resumed.trees) == 24
+    p = resumed.predict(X)
+    assert (p.argmax(axis=1) == y).mean() > 0.85
+
+
 def test_dart_rate_drop_zero_matches_gbtree_shape():
     X, y = _linear_data(400)
     dtrain = DataMatrix(X, labels=y)

@@ -1310,6 +1310,29 @@ def test_dart_mesh_matches_single_device(mesh8):
     )
 
 
+@pytest.mark.multichip
+def test_dart_multiclass_mesh_matches_single_device(mesh8):
+    """r5 guard lift: dart × multi:softprob on a data mesh. The per-class
+    vmap'd builder runs on row-sharded [n, C] gradients under GSPMD; the
+    shared-seed round-unit dropout bookkeeping is host-side and identical,
+    so predictions match single-device."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(1203, 5).astype(np.float32)  # not divisible by 8
+    y = rng.randint(0, 3, size=1203).astype(np.float32)
+    X[:, 1] += 2.5 * y
+    params = {
+        "booster": "dart", "objective": "multi:softprob", "num_class": 3,
+        "max_depth": 3, "rate_drop": 0.3, "one_drop": 1, "seed": 13,
+    }
+    single = train(params, DataMatrix(X, labels=y), num_boost_round=6)
+    dist = train(params, DataMatrix(X, labels=y), num_boost_round=6, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(single.predict(X[:200])),
+        np.asarray(dist.predict(X[:200])),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
 def test_mesh_with_pallas_hist_matches_single_device():
     """The production TPU configuration is the pallas histogram kernel
     INSIDE shard_map with the data-axis psum — the v5p pod path. It must

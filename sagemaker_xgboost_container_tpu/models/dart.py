@@ -185,6 +185,11 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
     _rows_cache = {}  # round-invariant global labels/weights (cox gather)
     stop = False
     for rnd in range(num_boost_round):
+        if session.approx_resketch:
+            # tree_method='approx': hessian-weighted candidate re-sketch per
+            # round, same as the gbtree dispatch path (the session re-bins in
+            # place; dropout bookkeeping is float-margin-space and unaffected)
+            session._resketch_bins()
         # ---- sample dropout set -----------------------------------------
         dropped = []
         if tree_contribs and rng.uniform() >= skip_drop:

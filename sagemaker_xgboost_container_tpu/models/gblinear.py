@@ -178,15 +178,30 @@ def train_linear(
         n_shards = int(mesh.shape["data"])
         if n_shards > 1:
             axis = "data"
+    grad_hess = objective.grad_hess
     if axis is not None and config.objective == "survival:cox":
-        # Cox risk sets span the whole dataset; inside shard_map grad_hess
-        # would see only shard-local rows and silently compute wrong risk
-        # sets (the tree path has a dedicated global-cumsum cox — this
-        # linear path does not yet)
-        raise exc.UserError(
-            "booster=gblinear with objective=survival:cox does not support "
-            "mesh training yet; run single-device."
-        )
+        # Cox risk sets span the whole dataset; inside shard_map the plain
+        # grad_hess would see only shard-local rows and silently compute
+        # wrong risk sets. Same recipe as the tree path's cox-on-mesh
+        # (booster.py cox_mesh_grad_hess): all_gather the global rows,
+        # compute replicated global gradients (padding rows carry weight 0
+        # and drop out of every cumsum), slice this shard's segment. Exact
+        # where the reference's per-worker Cox approximation is not.
+        base_grad_hess = grad_hess
+
+        def cox_mesh_grad_hess(m, y, wt):
+            M = jax.lax.all_gather(m, axis, tiled=True)
+            Y = jax.lax.all_gather(y, axis, tiled=True)
+            Wt = jax.lax.all_gather(wt, axis, tiled=True)
+            Gg, Hh = base_grad_hess(M, Y, Wt)
+            k = jax.lax.axis_index(axis)
+            c = m.shape[0]
+            return (
+                jax.lax.dynamic_slice(Gg, (k * c,), (c,)),
+                jax.lax.dynamic_slice(Hh, (k * c,), (c,)),
+            )
+
+        grad_hess = cox_mesh_grad_hess
 
     from .booster import _pad_rows
 
@@ -268,7 +283,7 @@ def train_linear(
         n_s = x_s.shape[0]
         m = x_s @ wc + bc[None, :] + base
         margins = m[:, 0] if G == 1 else m
-        g, h = objective.grad_hess(margins, labels_s, weights_s)
+        g, h = grad_hess(margins, labels_s, weights_s)
         g2 = g.reshape(n_s, G) if G > 1 else g[:, None]
         h2 = h.reshape(n_s, G) if G > 1 else h[:, None]
 

@@ -27,6 +27,28 @@ import os
 import sys
 
 PKG = "sagemaker_xgboost_container_tpu"
+
+# Known blind spots (VERDICT r4 weak #7): modules whose tests drive them OUT
+# of process, which sys.monitoring cannot see — their in-process percentages
+# under-report real coverage. Enumerated here so the artifact carries its own
+# exclusions; PARITY.md's gate section mirrors this list.
+SUBPROCESS_SHADOWED = {
+    "training/entry.py":
+        "tests/test_training_e2e.py runs `python -m ...training.entry` in a "
+        "subprocess (the SageMaker CMD contract)",
+    "training/algorithm_train.py":
+        "e2e subprocess entrypoint + 2-process jax.distributed workers "
+        "(tests/util_multiprocess.py) carry the distributed branches",
+    "parallel/distributed.py":
+        "cluster bring-up runs in spawned 2-process workers "
+        "(tests/test_parallel.py); only host-side helpers trace in-process",
+    "data/record_pb2.py":
+        "protoc-generated module: the class bodies execute at import; "
+        "descriptor plumbing is exercised via data/recordio.py round-trips",
+    "training/profiling.py":
+        "bench tooling: driven by scripts/dissect.py and bench.py on real "
+        "hardware, not by the unit tiers",
+}
 # an unreserved tool slot: coverage.py's sysmon mode owns the reserved
 # COVERAGE_ID (1), so a distinct id avoids colliding if both are active
 TOOL_ID = 4
@@ -123,17 +145,26 @@ def _stop_and_report(fail_under):
         total_exec += len(lines)
         total_hit += hit
         rel = fn[fn.find(PKG):] if PKG in fn else fn
-        per_file[rel] = {
+        entry = {
             "lines": len(lines),
             "hit": hit,
             "pct": round(100.0 * hit / len(lines), 1) if lines else 100.0,
         }
+        for suffix, why in SUBPROCESS_SHADOWED.items():
+            if rel.endswith(suffix):
+                entry["subprocess_shadowed"] = why
+        per_file[rel] = entry
     pct = 100.0 * total_hit / total_exec if total_exec else 0.0
     doc = {
         "total_pct": round(pct, 2),
         "fail_under": fail_under,
         "total_lines": total_exec,
         "total_hit": total_hit,
+        # the total is a FLOOR: these modules' real coverage lives in
+        # subprocesses the monitor can't see (enumerated per file below)
+        "blind_spots": sorted(
+            rel for rel, e in per_file.items() if "subprocess_shadowed" in e
+        ),
         "files": per_file,
     }
     try:

@@ -13,9 +13,14 @@
 #   mme      — multi-model endpoint REST lifecycle against a real
 #              `docker run` (reference test_multiple_model_endpoint.py:32-101)
 #
-# Usage: scripts/image_cluster.sh [cluster|kill|mme|all]
-# Needs Docker + compose (v2 `docker compose` or v1 `docker-compose`) and
-# network for the image build. Exit 75 = environment cannot run it (SKIP).
+# Usage: scripts/image_cluster.sh [cluster|kill|mme|all|dry]
+# cluster/kill/mme/all need Docker + compose (v2 `docker compose` or v1
+# `docker-compose`) and network for the image build; exit 75 = environment
+# cannot run them (SKIP). `dry` (VERDICT r4 #5) needs NEITHER: it validates
+# everything checkable without a docker daemon — Dockerfile structure and
+# COPY sources, the version contract + native-parser gates the build RUNs,
+# compose-file syntax, and console-script entrypoint wiring — so hosts
+# without Docker degrade to partial verification instead of a full skip.
 set -uo pipefail
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 DOCKER="${DOCKER:-docker}"
@@ -23,24 +28,27 @@ TAG="${IMAGE_TAG:-sagemaker-xgboost-tpu:cluster}"
 DATA_SRC="${ABALONE_DATA:-/root/reference/test/resources/abalone/data}"
 WHAT="${1:-all}"
 
-command -v "$DOCKER" >/dev/null || { echo "SKIP: $DOCKER not installed"; exit 75; }
-if "$DOCKER" compose version >/dev/null 2>&1; then
-  COMPOSE=("$DOCKER" compose)
-elif command -v docker-compose >/dev/null 2>&1; then
-  COMPOSE=(docker-compose)
-else
-  echo "SKIP: no docker compose available"; exit 75
-fi
+require_docker() {
+  command -v "$DOCKER" >/dev/null || { echo "SKIP: $DOCKER not installed"; exit 75; }
+  if "$DOCKER" compose version >/dev/null 2>&1; then
+    COMPOSE=("$DOCKER" compose)
+  elif command -v docker-compose >/dev/null 2>&1; then
+    COMPOSE=(docker-compose)
+  else
+    echo "SKIP: no docker compose available"; exit 75
+  fi
 
-echo "== build =="
-"$DOCKER" build -f "$REPO/docker/Dockerfile.tpu" \
-  --build-arg JAX_SPEC="${JAX_SPEC:-jax}" -t "$TAG" "$REPO" || exit 1
+  echo "== build =="
+  "$DOCKER" build -f "$REPO/docker/Dockerfile.tpu" \
+    --build-arg JAX_SPEC="${JAX_SPEC:-jax}" -t "$TAG" "$REPO" || exit 1
+}
+if [ "$WHAT" != dry ]; then require_docker; fi
 
 WORK="$(mktemp -d)"
 CID=""
 cleanup() {
   [ -n "$CID" ] && "$DOCKER" rm -f "$CID" >/dev/null 2>&1 || true
-  [ -f "$WORK/docker-compose.yml" ] \
+  [ -n "${COMPOSE+x}" ] && [ -f "$WORK/docker-compose.yml" ] \
     && (cd "$WORK" && "${COMPOSE[@]}" down -t 5 >/dev/null 2>&1) || true
   rm -rf "$WORK"
 }
@@ -194,13 +202,125 @@ JSON
   echo "MME TIER OK"
 }
 
+run_dry() {
+  echo "== dry: image-tier checks that need no docker daemon =="
+
+  echo "-- dockerfile structure + COPY sources"
+  python3 - "$REPO/docker/Dockerfile.tpu" "$REPO" <<'EOF' || return 1
+import re, sys
+
+path, ctx = sys.argv[1], sys.argv[2]
+KNOWN = {"FROM", "RUN", "COPY", "ADD", "ARG", "ENV", "ENTRYPOINT", "CMD",
+         "EXPOSE", "WORKDIR", "USER", "LABEL", "VOLUME", "SHELL",
+         "HEALTHCHECK", "STOPSIGNAL", "ONBUILD"}
+# join line continuations, drop comments/blanks
+raw = open(path).read()
+lines, buf = [], ""
+for line in raw.splitlines():
+    if not buf and (not line.strip() or line.lstrip().startswith("#")):
+        continue
+    buf += line
+    if buf.endswith("\\"):
+        buf = buf[:-1] + " "
+        continue
+    lines.append(buf)
+    buf = ""
+assert not buf, "dangling line continuation"
+instrs = []
+for ln in lines:
+    m = re.match(r"([A-Za-z]+)\s+(.*)", ln)
+    assert m, f"unparseable line: {ln!r}"
+    op = m.group(1).upper()
+    assert op in KNOWN, f"unknown instruction {op}"
+    instrs.append((op, m.group(2).strip()))
+first_non_arg = next(op for op, _ in instrs if op != "ARG")
+assert first_non_arg == "FROM", "first instruction must be FROM"
+# no ENTRYPOINT/CMD by design: SageMaker invokes the image with the literal
+# command "train"/"serve", resolved via PATH to the installed console
+# scripts (wiring asserted in the entrypoint step below)
+import os
+for op, rest in instrs:
+    if op in ("COPY", "ADD"):
+        parts = [p for p in rest.split() if not p.startswith("--")]
+        for src in parts[:-1]:
+            assert os.path.exists(os.path.join(ctx, src.lstrip("/"))) or src == ".", \
+                f"{op} source {src!r} missing from build context"
+print(f"   {len(instrs)} instructions ok")
+EOF
+
+  echo "-- version contract + native parser (the gates the image build runs)"
+  python3 -m sagemaker_xgboost_container_tpu.version_contract || return 1
+  python3 -c "from sagemaker_xgboost_container_tpu.data import native; \
+assert native.native_available(), 'native fastdata parser unavailable'" || return 1
+
+  echo "-- compose file syntax"
+  write_compose
+  # dry skips require_docker, so detect compose here (without requiring it):
+  # on docker hosts the real `compose config` validation runs even in dry
+  if [ -z "${COMPOSE+x}" ]; then
+    if command -v "$DOCKER" >/dev/null && "$DOCKER" compose version >/dev/null 2>&1; then
+      COMPOSE=("$DOCKER" compose)
+    elif command -v docker-compose >/dev/null 2>&1; then
+      COMPOSE=(docker-compose)
+    fi
+  fi
+  if [ -n "${COMPOSE+x}" ]; then
+    (cd "$WORK" && "${COMPOSE[@]}" config -q) || return 1
+  else
+    python3 - "$WORK/docker-compose.yml" <<'EOF' || return 1
+import sys
+
+try:
+    import yaml
+except ImportError:  # minimal structural check without pyyaml
+    text = open(sys.argv[1]).read()
+    assert "services:" in text and "algo-1:" in text and "algo-2:" in text
+    assert "&env" in text and "*env" in text, "anchor/alias pair missing"
+    print("   structural check ok (no pyyaml)")
+    sys.exit(0)
+doc = yaml.safe_load(open(sys.argv[1]))
+svcs = doc["services"]
+assert set(svcs) == {"algo-1", "algo-2"}, svcs.keys()
+for name, svc in svcs.items():
+    assert svc["image"], name
+    assert svc["command"] == "train", name
+    assert svc["volumes"] and svc["volumes"][0].endswith(":/opt/ml"), name
+    # the &env anchor must resolve to the same distributed-training env on both
+    assert svc["environment"]["SM_JAX_DISTRIBUTED"] == "on", name
+print("   yaml parse + anchor resolution ok")
+EOF
+  fi
+
+  echo "-- entrypoint wiring (setup.py console scripts resolve + on PATH)"
+  python3 - "$REPO" <<'EOF' || return 1
+import configparser, importlib, os, re, shutil, sys
+
+repo = sys.argv[1]
+setup = open(os.path.join(repo, "setup.py")).read()
+scripts = dict(re.findall(r"['\"](\w+)\s*=\s*([\w.:]+)['\"]", setup))
+assert "train" in scripts and "serve" in scripts, scripts
+for name, target in scripts.items():
+    mod, func = target.split(":")
+    m = importlib.import_module(mod)
+    assert callable(getattr(m, func)), target
+    # PATH presence is an env property (needs pip install); the image build
+    # always installs, so locally it only warns
+    exe = shutil.which(name)
+    note = exe or "not on PATH here; image build installs it"
+    print(f"   {name} -> {target} ({note})")
+EOF
+
+  echo "DRY TIER OK"
+}
+
 rc=0
 case "$WHAT" in
   cluster) run_cluster || rc=1 ;;
   kill)    run_kill || rc=1 ;;
   mme)     run_mme || rc=1 ;;
   all)     run_cluster || rc=1; run_kill || rc=1; run_mme || rc=1 ;;
-  *) echo "usage: $0 [cluster|kill|mme|all]"; exit 2 ;;
+  dry)     run_dry || rc=1 ;;
+  *) echo "usage: $0 [cluster|kill|mme|all|dry]"; exit 2 ;;
 esac
 [ $rc -eq 0 ] && echo "IMAGE CLUSTER OK"
 exit $rc

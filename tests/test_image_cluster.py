@@ -88,6 +88,21 @@ def test_sm_jax_distributed_on_forces_cpu_cluster():
         assert "UP 2" in out, outs
 
 
+def test_image_cluster_dry_tier():
+    """The docker-less `dry` tier (VERDICT r4 #5) must PASS on this host —
+    not skip: Dockerfile structure + COPY sources, the version-contract and
+    native-parser gates the image build runs, compose-file syntax, and
+    console-script wiring are all checkable without a docker daemon."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        ["bash", SCRIPT, "dry"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "DRY TIER OK" in r.stdout
+
+
 @pytest.mark.skipif(
     shutil.which(os.environ.get("DOCKER", "docker")) is None,
     reason="docker not installed on this host",

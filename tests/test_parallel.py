@@ -1290,6 +1290,50 @@ def test_gblinear_mesh_matches_single_device(mesh8):
 
 
 @pytest.mark.multichip
+def test_approx_resketch_mesh_matches_single_device(mesh8):
+    """tree_method=approx (r5 per-dispatch re-sketch): the hessian-weighted
+    cut refresh is computed from globally identical margins, so a data mesh
+    trains the same trees as single-device."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(1003, 5).astype(np.float32)
+    y = (np.sin(4 * X[:, 0]) + X[:, 1] * X[:, 2]).astype(np.float32)
+    params = {
+        "tree_method": "approx", "max_bin": 64, "max_depth": 3,
+        "_rounds_per_dispatch": 1,
+    }
+    single = train(params, DataMatrix(X, labels=y), num_boost_round=5)
+    dist = train(params, DataMatrix(X, labels=y), num_boost_round=5, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(single.predict(X[:200])),
+        np.asarray(dist.predict(X[:200])),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.mark.multichip
+def test_gblinear_cox_mesh_matches_single_device(mesh8):
+    """r5 guard lift: gblinear × survival:cox on a data mesh. The linear
+    round's grad/hess all_gathers the global rows for the risk-set cumsums
+    (same recipe as the tree path's cox-on-mesh), so coordinate-descent
+    updates match single-device. The reference trains this under Rabit."""
+    X, labels = _cox_data(n=1003, seed=17)  # not divisible by 8
+    params = {
+        "booster": "gblinear", "objective": "survival:cox",
+        "eta": 0.5, "lambda": 1.0, "alpha": 0.0, "seed": 3,
+    }
+    single = train(params, DataMatrix(X, labels=labels), num_boost_round=10)
+    dist = train(
+        params, DataMatrix(X, labels=labels), num_boost_round=10, mesh=mesh8
+    )
+    np.testing.assert_allclose(single.weights, dist.weights, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(single.bias, dist.bias, rtol=2e-4, atol=2e-5)
+    # the linear model orders risk: higher true hazard -> higher margin
+    m = dist.predict(X, output_margin=True)
+    hazard = 0.8 * X[:, 0] - 0.5 * X[:, 1]
+    assert np.corrcoef(m, hazard)[0, 1] > 0.6
+
+
+@pytest.mark.multichip
 def test_dart_mesh_matches_single_device(mesh8):
     """dart on a data mesh: the session shards rows; GSPMD partitions the
     dart builder's histogram ops, so dropout/rescale bookkeeping and trees

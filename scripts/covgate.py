@@ -45,9 +45,6 @@ SUBPROCESS_SHADOWED = {
     "data/record_pb2.py":
         "protoc-generated module: the class bodies execute at import; "
         "descriptor plumbing is exercised via data/recordio.py round-trips",
-    "training/profiling.py":
-        "bench tooling: driven by scripts/dissect.py and bench.py on real "
-        "hardware, not by the unit tiers",
 }
 # an unreserved tool slot: coverage.py's sysmon mode owns the reserved
 # COVERAGE_ID (1), so a distinct id avoids colliding if both are active

@@ -76,6 +76,13 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
                 "dropout rescales historical trees, so truncating to the best "
                 "iteration does not reproduce the best model."
             )
+    if config.num_parallel_tree > 1:
+        logger.warning(
+            "booster=dart ignores num_parallel_tree=%d and builds one tree "
+            "per class per round (libxgboost's dart samples dropout over "
+            "individual trees; this engine's dropout unit is the round).",
+            config.num_parallel_tree,
+        )
 
     # With a mesh the session shards rows over the data axis; dart's own
     # jitted builder/grad ops run on those sharded arrays under XLA's

@@ -17,8 +17,37 @@ def _fmt(v):
     return str(v)
 
 
+def _probe_section(out_dir):
+    """Render probe_summary.json (r5 phased taxonomy): the outage evidence
+    exists even when the chip never recovered and latest.json is absent."""
+    path = os.path.join(out_dir, "probe_summary.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        doc = json.load(f)
+    print("## Probe taxonomy ({} probes, updated {})".format(
+        doc.get("probes", "?"), doc.get("updated", "?")))
+    print()
+    print("| Outcome | Count |")
+    print("|---|---|")
+    for key, n in sorted(doc.get("taxonomy", {}).items()):
+        print("| {} | {} |".format(key, n))
+    for label in ("first", "last"):
+        rec = doc.get(label)
+        if rec:
+            print()
+            print("_{}: {} init={} compute={}{}_".format(
+                label, rec.get("t"), rec.get("init"), rec.get("compute"),
+                " — " + rec["err"] if rec.get("err") else ""))
+    print()
+
+
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(".tpuwatch", "latest.json")
+    _probe_section(os.path.dirname(path) or ".")
+    if not os.path.exists(path):
+        print("_no battery aggregate ({}): the chip never recovered_".format(path))
+        return
     with open(path) as f:
         doc = json.load(f)
     runs = doc.get("runs", {})

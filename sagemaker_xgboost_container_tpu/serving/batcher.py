@@ -19,6 +19,8 @@ import time
 
 import numpy as np
 
+from ..models.forest import _host_predict_rows
+
 logger = logging.getLogger(__name__)
 
 
@@ -75,10 +77,8 @@ class PredictBatcher:
         # forgoing the queue path's wait-timeout is safe — device-sized
         # payloads keep the worker handoff and its TimeoutError bound (the
         # tunneled-TPU wedge failure mode).
-        from ..models.forest import _host_predict_rows
-
         if (
-            feats.shape[0] <= _host_predict_rows()
+            0 < feats.shape[0] <= _host_predict_rows()
             and self._queue.empty()
             and self._exec_lock.acquire(blocking=False)
         ):

@@ -254,4 +254,56 @@ extern "C" int libsvm_fill_mt(const char* buf, int64_t len, int32_t nchunks,
     return 0;
 }
 
+// Scalar forest traversal for tiny serving payloads: the reference serves
+// single-row /invocations through libxgboost's C++ predictor
+// (serve_utils.py:244-250); the numpy twin (ops/predict.py
+// _leaf_nodes_impl, xp=np) pays ~0.3 ms of per-op interpreter overhead for
+// a 100-tree forest where this loop pays ~2 us. Semantics mirror
+// _leaf_nodes_impl EXACTLY (NaN-missing follows default_left; numerical
+// goes right on v >= threshold; categorical goes right when the truncated
+// int category's bit is set, invalid (v<0 or v>=32*W) goes left; leaves
+// self-loop, padded nodes are never visited). Arrays are the forest's
+// stacked [T, N] layout; cat_split/cat_mask may be NULL. out is [n, T]
+// per-tree leaf VALUES (group summing stays in Python, where tree_info
+// lives).
+extern "C" int forest_leaf_values(
+    const int32_t* feature, const float* threshold,
+    const uint8_t* default_left, const int32_t* left, const int32_t* right,
+    const uint8_t* is_leaf, const float* leaf_value,
+    const uint8_t* cat_split, const uint32_t* cat_mask,
+    int64_t T, int64_t N, int64_t W,
+    const float* x, int64_t n, int64_t d, int32_t depth, float* out) {
+  const float max_cat = (float)(W * 32);
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = x + i * d;
+    for (int64_t t = 0; t < T; ++t) {
+      const int64_t base = t * N;
+      int32_t node = 0;
+      for (int32_t step = 0; step < depth; ++step) {
+        if (is_leaf[base + node]) break;
+        const float v = row[feature[base + node]];
+        const bool miss = v != v;  // NaN
+        const bool dfl = default_left[base + node] != 0;
+        bool go_right;
+        if (cat_split != nullptr && cat_split[base + node]) {
+          if (miss) {
+            go_right = !dfl;
+          } else if (v < 0.0f || v >= max_cat) {  // invalid category -> left
+            go_right = false;
+          } else {
+            const int32_t c = (int32_t)v;  // truncation, matches astype(int32)
+            const uint32_t word = cat_mask[(base + node) * W + (c >> 5)];
+            go_right = ((word >> (c & 31)) & 1u) != 0u;
+          }
+        } else {
+          go_right = miss ? !dfl : (v >= threshold[base + node]);
+        }
+        node = go_right ? right[base + node] : left[base + node];
+      }
+      out[i * T + t] = leaf_value[base + node];
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -85,6 +85,10 @@ def _build():
 
 def _load():
     global _lib, _tried
+    # lock-free steady state: _lib/_tried are only ever written under the
+    # lock, and the serving hot path calls this per request
+    if _lib is not None or _tried:
+        return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib
@@ -126,6 +130,16 @@ def _load():
                 ] + [ctypes.c_void_p] * 6
             except AttributeError:  # stale cached single-thread .so
                 lib.libsvm_count_mt = None
+            try:
+                lib.forest_leaf_values.restype = ctypes.c_int
+                lib.forest_leaf_values.argtypes = (
+                    [ctypes.c_void_p] * 9
+                    + [ctypes.c_int64] * 3
+                    + [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                       ctypes.c_int32, ctypes.c_void_p]
+                )
+            except AttributeError:  # stale cached pre-r5 .so
+                lib.forest_leaf_values = None
             _lib = lib
         except Exception as e:  # no compiler / load failure -> python fallback
             logger.info("native libsvm parser unavailable (%s); using python parser", e)
@@ -135,6 +149,13 @@ def _load():
 
 def native_available():
     return _load() is not None
+
+
+def forest_predictor_available():
+    """True when the loaded library carries the r5 forest traversal symbol
+    (a stale cached pre-r5 .so can be native_available() without it)."""
+    lib = _load()
+    return lib is not None and getattr(lib, "forest_leaf_values", None) is not None
 
 
 def parse_libsvm_native(data):
@@ -204,3 +225,60 @@ def _parse_threads(nbytes):
         return max(1, int(env))
     per_thread = 8 << 20
     return max(1, min(os.cpu_count() or 1, 16, nbytes // per_thread))
+
+
+def forest_leaf_values_native(stacked, x):
+    """Stacked forest + [n, d] float32 rows -> [n, T] per-tree leaf values
+    via the C++ traversal (native/fastdata.cpp::forest_leaf_values), or None
+    when the native library (or, for stale cached builds, the symbol) is
+    unavailable — callers fall back to the numpy twin.
+
+    The ctypes-ready operand tuple is cached ON the stacked dict (memoized
+    per forest slice in Forest._stack), so steady-state serving requests do
+    zero dtype conversions.
+    """
+    lib = _load()
+    if lib is None or getattr(lib, "forest_leaf_values", None) is None:
+        return None
+    args = stacked.get("_native_args")
+    if args is None:
+        def prep(key, dtype):
+            a = np.asarray(stacked[key])
+            if a.dtype == np.bool_ and dtype == np.uint8:
+                a = a.view(np.uint8)  # same itemsize: free
+            return np.ascontiguousarray(a, dtype)
+
+        feature = prep("feature", np.int32)
+        T, N = feature.shape
+        if "cat_split" in stacked:
+            cat_split = prep("cat_split", np.uint8)
+            cat_mask = np.ascontiguousarray(stacked["cat_mask"], np.uint32)
+            W = cat_mask.shape[2]
+        else:
+            cat_split = cat_mask = None
+            W = 0
+        args = (
+            feature, prep("threshold", np.float32),
+            prep("default_left", np.uint8), prep("left", np.int32),
+            prep("right", np.int32), prep("is_leaf", np.uint8),
+            prep("leaf_value", np.float32), cat_split, cat_mask,
+            T, N, W, int(stacked["depth"]),
+        )
+        stacked["_native_args"] = args
+    (feature, threshold, default_left, left, right, is_leaf, leaf_value,
+     cat_split, cat_mask, T, N, W, depth) = args
+    x = np.ascontiguousarray(x, np.float32)
+    n, d = x.shape
+    out = np.empty((n, T), np.float32)
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+
+    rc = lib.forest_leaf_values(
+        ptr(feature), ptr(threshold), ptr(default_left), ptr(left),
+        ptr(right), ptr(is_leaf), ptr(leaf_value), ptr(cat_split),
+        ptr(cat_mask), T, N, W, ptr(x), n, d, depth, ptr(out),
+    )
+    if rc != 0:  # pragma: no cover - the traversal cannot fail today
+        return None
+    return out

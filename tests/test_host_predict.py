@@ -65,6 +65,52 @@ def test_host_matches_device_categorical():
     np.testing.assert_allclose(host, [exp for _, exp in CASES], rtol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "objective,num_class",
+    [("reg:squarederror", None), ("binary:logistic", None), ("multi:softprob", 3)],
+)
+def test_native_host_matches_numpy_host(objective, num_class, monkeypatch):
+    """r5: the C++ traversal (fastdata.cpp::forest_leaf_values) must be
+    BIT-identical to the numpy twin on every routing rule — both produce
+    per-tree leaf values, and the group summing is shared numpy."""
+    from sagemaker_xgboost_container_tpu.data.native import forest_predictor_available
+
+    if not forest_predictor_available():
+        pytest.skip("no native forest traversal on this host")
+    forest = _trained_forest(objective, num_class, seed=5)
+    rng = np.random.RandomState(11)
+    X = rng.rand(9, 6).astype(np.float32)
+    X[rng.rand(9, 6) < 0.25] = np.nan
+    stacked = forest._stack(slice(0, len(forest.trees)))
+    info = forest.tree_info
+    kw = dict(num_output_group=forest.num_output_group, tree_info=info)
+
+    monkeypatch.setenv("GRAFT_HOST_PREDICT_IMPL", "numpy")
+    a = host_predict_margin(stacked, X, **kw)
+    monkeypatch.delenv("GRAFT_HOST_PREDICT_IMPL")
+    b = host_predict_margin(stacked, X, **kw)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_native_host_matches_numpy_host_categorical(monkeypatch):
+    """Category bitmask membership, invalid categories (negative /
+    out-of-range floats), and NaN-missing agree between C++ and numpy."""
+    from sagemaker_xgboost_container_tpu.data.native import forest_predictor_available
+
+    if not forest_predictor_available():
+        pytest.skip("no native forest traversal on this host")
+    forest = _categorical_forest()
+    stacked = forest._stack(slice(0, 1))
+    X = np.array([[f0, f1] for (f0, f1), _ in CASES], np.float32)
+
+    monkeypatch.setenv("GRAFT_HOST_PREDICT_IMPL", "numpy")
+    a = host_predict_margin(stacked, X)
+    monkeypatch.delenv("GRAFT_HOST_PREDICT_IMPL")
+    b = host_predict_margin(stacked, X)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(b, [exp for _, exp in CASES], rtol=1e-6)
+
+
 def test_threshold_respected(monkeypatch):
     """Above the cutover the device path must still be used (power-of-2
     padded), below it the host path — outputs agree either way."""

@@ -92,7 +92,6 @@ def _load():
     with _lock:
         if _lib is not None or _tried:
             return _lib
-        _tried = True
         try:
             kind, lib_path = _resolve_lib_path()
             if kind == "rebuild":
@@ -144,6 +143,11 @@ def _load():
         except Exception as e:  # no compiler / load failure -> python fallback
             logger.info("native libsvm parser unavailable (%s); using python parser", e)
             _lib = None
+        finally:
+            # set only AFTER the attempt: the unlocked fast path above must
+            # not return None to concurrent callers while a first build is
+            # still running behind the lock
+            _tried = True
     return _lib
 
 
@@ -257,27 +261,29 @@ def forest_leaf_values_native(stacked, x):
         else:
             cat_split = cat_mask = None
             W = 0
-        args = (
+        arrays = (
             feature, prep("threshold", np.float32),
             prep("default_left", np.uint8), prep("left", np.int32),
             prep("right", np.int32), prep("is_leaf", np.uint8),
             prep("leaf_value", np.float32), cat_split, cat_mask,
-            T, N, W, int(stacked["depth"]),
         )
+        # pointers precomputed as plain ints: ndarray.ctypes.data_as costs
+        # ~2 us each and there are nine forest operands per call — the
+        # `arrays` tuple cached alongside keeps the buffers alive
+        ptrs = tuple(
+            a.__array_interface__["data"][0] if a is not None else None
+            for a in arrays
+        )
+        args = (arrays, ptrs, T, N, W, int(stacked["depth"]))
         stacked["_native_args"] = args
-    (feature, threshold, default_left, left, right, is_leaf, leaf_value,
-     cat_split, cat_mask, T, N, W, depth) = args
+    _arrays, ptrs, T, N, W, depth = args
     x = np.ascontiguousarray(x, np.float32)
     n, d = x.shape
     out = np.empty((n, T), np.float32)
-
-    def ptr(a):
-        return a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
-
     rc = lib.forest_leaf_values(
-        ptr(feature), ptr(threshold), ptr(default_left), ptr(left),
-        ptr(right), ptr(is_leaf), ptr(leaf_value), ptr(cat_split),
-        ptr(cat_mask), T, N, W, ptr(x), n, d, depth, ptr(out),
+        *ptrs, T, N, W,
+        x.__array_interface__["data"][0], n, d, depth,
+        out.__array_interface__["data"][0],
     )
     if rc != 0:  # pragma: no cover - the traversal cannot fail today
         return None

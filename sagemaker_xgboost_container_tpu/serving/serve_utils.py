@@ -195,8 +195,13 @@ def warmup_predict_async(model):
 
     def _warm():
         try:
+            from ..data.native import forest_predictor_available
             from ..models.forest import _host_predict_rows, predict_bucket
 
+            # host-path sizes compile nothing, but they DO lazily build the
+            # C++ traversal (g++ on dev trees without a packaged .so) —
+            # trigger that load here, off the request path
+            forest_predictor_available()
             t = _host_predict_rows()
             # distinct device buckets only: the smallest one past the host
             # threshold plus a representative batch bucket (skipping sizes

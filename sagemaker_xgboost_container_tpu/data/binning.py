@@ -130,7 +130,11 @@ def _device_cut_points(features, w, max_cuts):
     L = max(n, max_cuts)
 
     @jax.jit
-    def kernel(cols, wv):
+    def kernel(feats, wv):
+        # transpose INSIDE the program: XLA folds it into layout assignment
+        # instead of materializing an eager [d, n] copy per call (the approx
+        # re-sketch calls this every dispatch on staged device features)
+        cols = feats.T
         def one(col):
             nanm = jnp.isnan(col)
             # two-key sort: primary = value (NaN mapped to +inf), secondary =
@@ -193,7 +197,7 @@ def _device_cut_points(features, w, max_cuts):
         return jax.vmap(one)(cols)
 
     mids, counts = kernel(
-        jnp.asarray(features.T, jnp.float32), jnp.asarray(w, jnp.float32)
+        jnp.asarray(features, jnp.float32), jnp.asarray(w, jnp.float32)
     )
     mids = np.asarray(mids, np.float32)
     counts = np.asarray(counts)
@@ -257,20 +261,21 @@ def _device_apply(features, cut_points, max_bin, dtype):
         counts[f] = len(c)
 
     @jax.jit
-    def kernel(cols, cuts, cnts):
+    def kernel(feats, cuts, cnts):
+        cols = feats.T  # folded into the program (see _device_cut_points)
         def one(col, cf, kf):
             idx = jnp.searchsorted(cf, col, side="right")
             idx = jnp.minimum(idx, kf)          # +inf values -> n_cuts
             return jnp.where(jnp.isnan(col), max_bin, idx)
 
-        return jax.vmap(one)(cols, cuts, cnts)
+        return jax.vmap(one)(cols, cuts, cnts).T
 
     out = kernel(
-        jnp.asarray(features.T, jnp.float32),
+        jnp.asarray(features, jnp.float32),
         jnp.asarray(padded),
         jnp.asarray(counts),
     )
-    return np.asarray(out).T.astype(dtype)
+    return np.asarray(out).astype(dtype)
 
 
 def bin_matrix(dmatrix, max_bin=256, cut_points=None, exact_cap=None):

@@ -444,6 +444,8 @@ class _TrainingSession:
         # approx re-sketch state (see _resketch_bins)
         self._dtrain = dtrain
         self._grad_fn = None
+        self._feats_dev = None       # device-staged float features (sketch)
+        self._eval_feats_dev = {}    # eval-set index -> device features
         self.approx_resketch = (
             config.tree_method == "approx"
             and os.environ.get("GRAFT_APPROX_RESKETCH", "1") != "0"
@@ -945,19 +947,36 @@ class _TrainingSession:
         active when they were built. Runs before EVERY dispatch (including
         the first: libxgboost hessian-weights the iteration-0 sketch too —
         from the base margin, or real margins on checkpoint resume)."""
-        from ..data.binning import apply_cut_points, compute_cut_points
+        from ..data.binning import (
+            _sketch_impl, apply_cut_points, compute_cut_points,
+        )
 
         if self._grad_fn is None:
             self._grad_fn = jax.jit(self.objective.grad_hess)
         _g, h = self._grad_fn(self.margins, self.labels, self.weights)
-        h_host = np.asarray(self._to_host(h, self.n), np.float32)
-        if h_host.ndim == 2:  # multi-class: sketch weight = summed class hessians
-            h_host = h_host.sum(axis=1)
+        if h.ndim == 2:  # multi-class: sketch weight = summed class hessians
+            h = h.sum(axis=1)
         max_bin = self.train_binned.max_bin
-        feats = self._dtrain.features
+        device_sketch = not self.is_multiprocess and _sketch_impl() == "device"
+        if not device_sketch:
+            h_host = np.asarray(self._to_host(h, self.n), np.float32)
         if self.is_multiprocess:
             cuts = _merged_distributed_cuts(self._dtrain, max_bin, weights=h_host)
+            feats = self._dtrain.features
+        elif device_sketch:
+            # TPU path: float features staged on device ONCE — re-uploading
+            # [n, d] floats every dispatch would pay n*d*4 bytes of
+            # host->HBM per round; hessians never leave the device at all.
+            # Trade: the staged floats stay resident (n*d*4 bytes of HBM)
+            # alongside the round program for the whole job —
+            # GRAFT_SKETCH_IMPL=host trades them back for per-round uploads
+            # if an approx job is HBM-bound.
+            if self._feats_dev is None:
+                self._feats_dev = jnp.asarray(self._dtrain.features, jnp.float32)
+            feats = self._feats_dev
+            cuts = compute_cut_points(feats, h[: self.n], max_bin)
         else:
+            feats = self._dtrain.features
             cuts = compute_cut_points(feats, h_host, max_bin)
         self._stage_train_bins(
             apply_cut_points(feats, cuts, max_bin), cuts, max_bin
@@ -967,7 +986,12 @@ class _TrainingSession:
         for i, (name, dm, binned) in enumerate(self.eval_sets):
             if self.eval_bins[i] is None:
                 continue
-            eb = np.asarray(apply_cut_points(dm.features, cuts, max_bin))
+            efeats = dm.features
+            if device_sketch:
+                if i not in self._eval_feats_dev:
+                    self._eval_feats_dev[i] = jnp.asarray(efeats, jnp.float32)
+                efeats = self._eval_feats_dev[i]
+            eb = np.asarray(apply_cut_points(efeats, cuts, max_bin))
             self.eval_bins[i] = self._put(
                 _pad_rows(eb, self._eval_pads[i], max_bin), P("data", None)
             )

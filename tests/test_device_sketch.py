@@ -144,3 +144,61 @@ def test_device_sketch_small_n_and_infinities():
     assert np.isinf(h[-1])  # host keeps the inf rep -> inf cut
     assert h.shape == d.shape
     np.testing.assert_allclose(h, d)
+
+
+def test_approx_resketch_device_impl(monkeypatch):
+    """r5: tree_method=approx with the on-device sketch lowering (the TPU
+    default) — the per-dispatch re-sketch keeps features device-resident
+    (no per-round [n, d] re-upload) and hessian weights never leave the
+    device. Quality must stay in the host-impl band and the cuts must
+    actually refresh between dispatches."""
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.models.booster import (
+        TrainConfig, _TrainingSession,
+    )
+    from sagemaker_xgboost_container_tpu.models.forest import Forest
+
+    rng = np.random.RandomState(6)
+    X = rng.rand(3000, 5).astype(np.float32)
+    y = (np.sin(5 * X[:, 0]) + X[:, 1] ** 2 + 0.05 * rng.randn(3000)).astype(
+        np.float32
+    )
+    monkeypatch.setenv("GRAFT_SKETCH_IMPL", "device")
+    f_dev = train(
+        {"tree_method": "approx", "max_bin": 64, "max_depth": 4},
+        DataMatrix(X, labels=y),
+        num_boost_round=8,
+    )
+    monkeypatch.setenv("GRAFT_SKETCH_IMPL", "host")
+    f_host = train(
+        {"tree_method": "approx", "max_bin": 64, "max_depth": 4},
+        DataMatrix(X, labels=y),
+        num_boost_round=8,
+    )
+    rmse_d = float(np.sqrt(np.mean((np.asarray(f_dev.predict(X)) - y) ** 2)))
+    rmse_h = float(np.sqrt(np.mean((np.asarray(f_host.predict(X)) - y) ** 2)))
+    assert abs(rmse_d - rmse_h) < 0.05 * max(rmse_h, 1e-6), (rmse_d, rmse_h)
+
+    # the device features are staged once and the cuts refresh in place
+    monkeypatch.setenv("GRAFT_SKETCH_IMPL", "device")
+    yb = (X[:, 0] > 0.5).astype(np.float32)
+    cfg = TrainConfig(
+        {"tree_method": "approx", "max_bin": 32,
+         "objective": "binary:logistic", "max_depth": 3}
+    )
+    session = _TrainingSession(
+        cfg, DataMatrix(X, labels=yb), [],
+        Forest(objective_name=cfg.objective, base_score=cfg.base_score,
+               num_feature=X.shape[1]),
+    )
+    session.run_rounds()
+    staged = session._feats_dev
+    assert staged is not None
+    cuts0 = [np.asarray(c).copy() for c in session.cuts]
+    session.run_rounds()
+    assert session._feats_dev is staged, "features must stage exactly once"
+    assert any(
+        a.shape != np.asarray(b).shape or not np.allclose(a, np.asarray(b))
+        for a, b in zip(cuts0, session.cuts)
+    )

@@ -195,7 +195,11 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
         if session.approx_resketch:
             # tree_method='approx': hessian-weighted candidate re-sketch per
             # round, same as the gbtree dispatch path (the session re-bins in
-            # place; dropout bookkeeping is float-margin-space and unaffected)
+            # place; dropout bookkeeping is float-margin-space and unaffected).
+            # Sketch weights come from the FULL-forest margins — the dropout
+            # set isn't sampled yet; libxgboost sketches from the
+            # dropout-adjusted gradients, a one-round-lag nuance at
+            # rate_drop-sized magnitude.
             session._resketch_bins()
         # ---- sample dropout set -----------------------------------------
         dropped = []

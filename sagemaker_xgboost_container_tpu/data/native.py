@@ -245,6 +245,8 @@ def forest_leaf_values_native(stacked, x):
     if lib is None or getattr(lib, "forest_leaf_values", None) is None:
         return None
     args = stacked.get("_native_args")
+    if isinstance(args, str):  # "invalid": corrupt indices, numpy handles it
+        return None
     if args is None:
         def prep(key, dtype):
             a = np.asarray(stacked[key])
@@ -261,10 +263,25 @@ def forest_leaf_values_native(stacked, x):
         else:
             cat_split = cat_mask = None
             W = 0
+        left = prep("left", np.int32)
+        right = prep("right", np.int32)
+        # index sanity, checked ONCE per stacked forest: the numpy twin
+        # raises IndexError on a corrupt BYO model's out-of-range node ids;
+        # the C++ loop would read out of bounds — refuse and let the caller
+        # fall back to numpy (which fails loudly and safely)
+        if feature.size == 0 or (
+            left.min() < 0 or left.max() >= N
+            or right.min() < 0 or right.max() >= N
+            or feature.min() < 0
+        ):
+            # zero-node dicts (never produced by Forest._stack) also refuse:
+            # the numpy twin is the one with defined empty-input semantics
+            stacked["_native_args"] = "invalid"
+            return None
         arrays = (
             feature, prep("threshold", np.float32),
-            prep("default_left", np.uint8), prep("left", np.int32),
-            prep("right", np.int32), prep("is_leaf", np.uint8),
+            prep("default_left", np.uint8), left, right,
+            prep("is_leaf", np.uint8),
             prep("leaf_value", np.float32), cat_split, cat_mask,
         )
         # pointers precomputed as plain ints: ndarray.ctypes.data_as costs
@@ -274,11 +291,14 @@ def forest_leaf_values_native(stacked, x):
             a.__array_interface__["data"][0] if a is not None else None
             for a in arrays
         )
-        args = (arrays, ptrs, T, N, W, int(stacked["depth"]))
+        fmax = int(feature.max())  # non-empty: the guard above refused size 0
+        args = (arrays, ptrs, T, N, W, int(stacked["depth"]), fmax)
         stacked["_native_args"] = args
-    _arrays, ptrs, T, N, W, depth = args
+    _arrays, ptrs, T, N, W, depth, fmax = args
     x = np.ascontiguousarray(x, np.float32)
     n, d = x.shape
+    if fmax >= d:  # feature id beyond payload width: numpy raises cleanly
+        return None
     out = np.empty((n, T), np.float32)
     rc = lib.forest_leaf_values(
         *ptrs, T, N, W,

@@ -111,6 +111,33 @@ def test_native_host_matches_numpy_host_categorical(monkeypatch):
     np.testing.assert_allclose(b, [exp for _, exp in CASES], rtol=1e-6)
 
 
+def test_native_refuses_corrupt_indices():
+    """A corrupt BYO model with out-of-range node/feature ids must never
+    reach the C++ loop (OOB read); the native wrapper refuses ONCE per
+    stacked forest and callers fall back to numpy, which fails loudly."""
+    from sagemaker_xgboost_container_tpu.data.native import (
+        forest_leaf_values_native, forest_predictor_available,
+    )
+
+    if not forest_predictor_available():
+        pytest.skip("no native forest traversal on this host")
+    forest = _trained_forest(seed=2)
+    X = np.random.RandomState(0).rand(3, 6).astype(np.float32)
+
+    bad = dict(forest._stack(slice(0, len(forest.trees))))
+    bad.pop("_native_args", None)  # fresh validation on the mutated copy
+    bad["left"] = np.asarray(bad["left"]).copy()
+    bad["left"][0, 0] = 10**6  # node id far past N
+    assert forest_leaf_values_native(bad, X) is None
+    assert forest_leaf_values_native(bad, X) is None  # cached refusal
+
+    wide = dict(forest._stack(slice(0, len(forest.trees))))
+    wide.pop("_native_args", None)
+    wide["feature"] = np.asarray(wide["feature"]).copy()
+    wide["feature"][0, 0] = 99  # feature id beyond the payload width
+    assert forest_leaf_values_native(wide, X) is None
+
+
 def test_threshold_respected(monkeypatch):
     """Above the cutover the device path must still be used (power-of-2
     padded), below it the host path — outputs agree either way."""

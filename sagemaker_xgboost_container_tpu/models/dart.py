@@ -191,6 +191,11 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
     evals_log = {}
     _rows_cache = {}  # round-invariant global labels/weights (cox gather)
     stop = False
+    # full callback protocol, like the gbtree loop (booster.py): RoundTimer's
+    # round-0 timestamp and phase recorder are armed in before_training
+    for cb in callbacks:
+        if hasattr(cb, "before_training"):
+            forest = cb.before_training(forest) or forest
     for rnd in range(num_boost_round):
         if session.approx_resketch:
             # tree_method='approx': hessian-weighted candidate re-sketch per

@@ -378,6 +378,11 @@ def train_linear(
     model.rounds = start_round
     evals_log = {}
     stop = False
+    # full callback protocol, like the gbtree loop (booster.py): RoundTimer's
+    # round-0 timestamp and phase recorder are armed in before_training
+    for cb in callbacks:
+        if hasattr(cb, "before_training"):
+            model = cb.before_training(model) or model
     for rnd in range(start_round, start_round + num_boost_round):
         w, b = one_round(w, b)
         model.weights = np.asarray(w)

@@ -186,6 +186,11 @@ def train_update(config, forest, dtrain, evals, feval, callbacks, num_boost_roun
     evals_log = {}
     _rows_cache = {}  # round-invariant global labels/weights (cox gather)
     stop = False
+    # full callback protocol, like the gbtree loop (booster.py): RoundTimer's
+    # round-0 timestamp and phase recorder are armed in before_training
+    for cb in callbacks:
+        if hasattr(cb, "before_training"):
+            forest = cb.before_training(forest) or forest
     for rnd in range(rounds):
         g, h = objective.grad_hess(margins, labels, weights)
         g = np.asarray(g, np.float64)

@@ -1,4 +1,5 @@
-"""The scoring WSGI application: /ping, /invocations, /execution-parameters.
+"""The scoring WSGI application: /ping, /invocations, /execution-parameters,
+and (env-gated) /metrics.
 
 Route + status-code parity with the reference Flask app
 (algorithm_mode/serve.py:138-249): 204 on empty payload, 415 on undecodable
@@ -22,6 +23,7 @@ import os
 import threading
 
 from .. import constants
+from ..telemetry import instrument_wsgi
 from ..toolkit import exceptions as exc
 from . import serve_utils
 
@@ -269,7 +271,8 @@ def make_app(scoring_service=None, hooks=None):
             logger.exception("unhandled serving error")
             return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
 
-    return app
+    # middleware owns /metrics (SM_SERVING_METRICS gate) + per-route metrics
+    return instrument_wsgi(app)
 
 
 def _hooked_model(service, hooks):

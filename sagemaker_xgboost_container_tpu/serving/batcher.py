@@ -20,8 +20,12 @@ import time
 import numpy as np
 
 from ..models.forest import _host_predict_rows
+from ..telemetry import POW2_BUCKETS, REGISTRY
 
 logger = logging.getLogger(__name__)
+
+# linger is bounded by max_wait_ms (default 2ms) — sub-ms buckets
+_LINGER_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05)
 
 
 class _Pending:
@@ -49,10 +53,69 @@ class PredictBatcher:
     (None = unbounded) bounds in-flight requests, rejecting beyond it.
     """
 
-    def __init__(self, predict_fn, max_batch_rows=16384, max_wait_ms=2.0, max_queue=None):
+    def __init__(
+        self,
+        predict_fn,
+        max_batch_rows=16384,
+        max_wait_ms=2.0,
+        max_queue=None,
+        name="default",
+        registry=None,
+    ):
         self.predict_fn = predict_fn
         self.max_batch_rows = max_batch_rows
         self.max_wait_ms = max_wait_ms
+        # metric identity is (name, labels). Live cardinality stays bounded:
+        # MME unload/evict retires a model's series (mme._drop_batcher_metrics),
+        # so churn through many model names cannot grow the registry forever.
+        reg = registry or REGISTRY
+        labels = {"batcher": name}
+        self._m_requests = reg.counter(
+            "batcher_requests_total", "Predict calls accepted", labels
+        )
+        self._m_inline = reg.counter(
+            "batcher_inline_total", "Idle fast-path runs on the caller thread", labels
+        )
+        self._m_rejected = reg.counter(
+            "batcher_rejected_total", "JobQueueFull rejections", labels
+        )
+        self._m_timeouts = reg.counter(
+            "batcher_queue_timeout_total",
+            "Callers that gave up waiting (zombie pendings: the worker may "
+            "still dispatch their rows)",
+            labels,
+        )
+        self._m_dispatch = reg.counter(
+            "batcher_dispatch_total", "Kernel dispatches (batches executed)", labels
+        )
+        self._m_coalesced = reg.counter(
+            "batcher_coalesced_requests_total",
+            "Requests that shared a dispatch with at least one other "
+            "(coalescing ratio = this / batcher_requests_total)",
+            labels,
+        )
+        self._m_queue_depth = reg.gauge(
+            "batcher_queue_depth", "Requests waiting in the coalescing queue", labels
+        )
+        self._m_batch_rows = reg.histogram(
+            "batcher_batch_rows", "Rows per dispatched batch", labels, POW2_BUCKETS
+        )
+        self._m_batch_requests = reg.histogram(
+            "batcher_batch_requests",
+            "Requests coalesced per dispatched batch",
+            labels,
+            POW2_BUCKETS,
+        )
+        self._m_linger = reg.histogram(
+            "batcher_linger_seconds",
+            "Time spent collecting a batch before dispatch",
+            labels,
+            _LINGER_BUCKETS,
+        )
+        # test-and-set under a lock: a timeout storm expires many waiters at
+        # the same instant, and the log-once guard must hold exactly then
+        self._timeout_log_lock = threading.Lock()
+        self._timeout_logged = False
         # bounded queue -> the limit is atomic (put_nowait raises Full);
         # a qsize() check-then-put would race under concurrent WSGI threads.
         # Clamped to >=1 when bounded: Queue(maxsize=0) means UNLIMITED in
@@ -84,6 +147,8 @@ class PredictBatcher:
         ):
             try:
                 if self._queue.empty() and self._carry is None:
+                    self._m_requests.inc()
+                    self._m_inline.inc()
                     return np.asarray(self.predict_fn(feats))
             finally:
                 self._exec_lock.release()
@@ -91,10 +156,28 @@ class PredictBatcher:
         try:
             self._queue.put_nowait(pending)
         except queue.Full:
+            self._m_rejected.inc()
             raise JobQueueFull(
                 "job queue full ({} pending)".format(self.max_queue)
             )
+        self._m_requests.inc()
+        self._m_queue_depth.set(self._queue.qsize())
         if not pending.event.wait(timeout):
+            # zombie pending: this caller gives up, but the worker still holds
+            # the _Pending and may dispatch its rows later — wasted compute
+            # that a timeout storm multiplies. Count every one; log the first
+            # at WARNING so the storm is visible without flooding the log.
+            self._m_timeouts.inc()
+            with self._timeout_log_lock:
+                should_log, self._timeout_logged = not self._timeout_logged, True
+            if should_log:
+                logger.warning(
+                    "prediction timed out after %.1fs in the batch queue; the "
+                    "batch worker may still dispatch the abandoned rows. "
+                    "Further timeouts are counted in batcher_queue_timeout_total "
+                    "without logging.",
+                    timeout,
+                )
             raise TimeoutError("prediction timed out in the batch queue")
         if pending.error is not None:
             raise pending.error
@@ -147,8 +230,18 @@ class PredictBatcher:
             # JobQueueFull bound stays meaningful (at most one request — the
             # one just dequeued — sits outside the queue while blocked here)
             with self._exec_lock:
+                drain_start = time.monotonic()
                 batch = self._drain_batch(first, wait=loaded)
                 loaded = len(batch) > 1
+                self._m_linger.observe(time.monotonic() - drain_start)
+                self._m_queue_depth.set(self._queue.qsize())
+                self._m_dispatch.inc()
+                self._m_batch_requests.observe(len(batch))
+                self._m_batch_rows.observe(
+                    sum(p.features.shape[0] for p in batch)
+                )
+                if len(batch) > 1:
+                    self._m_coalesced.inc(len(batch))
                 try:
                     stacked = (
                         batch[0].features

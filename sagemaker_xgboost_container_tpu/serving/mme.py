@@ -55,6 +55,16 @@ def _max_request_size():
     return min(value, MAX_CONTENT_LEN_LIMIT)
 
 
+def _drop_batcher_metrics(name):
+    """Unload/evict lifecycle: retire the model's batcher metric series so
+    model churn on a long-lived endpoint can't grow the registry (and the
+    /metrics exposition + snapshot records) without bound. A reload of the
+    same name starts fresh series — acceptable: the model was gone."""
+    from ..telemetry import REGISTRY
+
+    REGISTRY.remove_matching("batcher", name)
+
+
 def _job_queue_size():
     return env_int("SAGEMAKER_MODEL_JOB_QUEUE_SIZE", 100)
 
@@ -78,6 +88,7 @@ class ModelManager:
             batcher = PredictBatcher(
                 lambda feats, _m=model, _r=rng: _m.predict(feats, iteration_range=_r),
                 max_queue=_job_queue_size(),
+                name=name,  # per-model metric series, bounded by the LRU cap
             )
         workers = os.getenv("SAGEMAKER_NUM_MODEL_WORKERS")
         if workers and workers != "1":
@@ -92,6 +103,7 @@ class ModelManager:
             self._models[name] = (model, fmt, model_dir, batcher)
             if self.max_models and len(self._models) > self.max_models:
                 evicted, _ = self._models.popitem(last=False)
+                _drop_batcher_metrics(evicted)
                 logger.info("Evicted model %s (LRU cap %d)", evicted, self.max_models)
             # compile the first device buckets off the request path — only
             # for a model that survived registration AND the LRU eviction
@@ -106,6 +118,7 @@ class ModelManager:
             if name not in self._models:
                 raise KeyError(name)
             del self._models[name]
+            _drop_batcher_metrics(name)
 
     def get(self, name):
         with self._lock:
@@ -204,7 +217,9 @@ def make_mme_app(manager=None):
             logger.exception("unhandled MME error")
             return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
 
-    return app
+    from ..telemetry import instrument_wsgi
+
+    return instrument_wsgi(app)
 
 
 def _query_params(environ):

@@ -19,14 +19,20 @@ import logging
 import os
 import signal
 import sys
+import threading
+import time
 from socketserver import ThreadingMixIn
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from .. import constants
+from .. import telemetry
+from ..utils.logging_config import setup_main_logger
 from .app import ScoringService, make_app
 from .mme import make_mme_app
 
 logger = logging.getLogger(__name__)
+
+METRICS_INTERVAL_ENV = "SM_METRICS_EMIT_INTERVAL_S"
 
 HOOK_NAMES = ("model_fn", "input_fn", "predict_fn", "output_fn", "transform_fn")
 
@@ -89,11 +95,48 @@ def build_app():
     return make_app(ScoringService(model_dir), hooks=hooks)
 
 
+def start_metrics_reporter(interval=None, registry=None):
+    """Daemon thread emitting one ``serving.snapshot`` structured record every
+    ``SM_METRICS_EMIT_INTERVAL_S`` seconds — the CloudWatch-scrapable view of
+    serving metrics for fleets without a Prometheus scraper. Off by default
+    (interval unset/0). Returns the thread, or None when disabled."""
+    if interval is None:
+        try:
+            interval = float(os.environ.get(METRICS_INTERVAL_ENV, "0") or 0)
+        except ValueError:
+            logger.warning("invalid %s; metrics reporter disabled", METRICS_INTERVAL_ENV)
+            return None
+    if interval <= 0:
+        return None
+    reg = registry or telemetry.REGISTRY
+
+    def _report():
+        while True:
+            time.sleep(interval)
+            try:
+                telemetry.emit_metric(
+                    "serving.snapshot", **telemetry.snapshot_fields(reg)
+                )
+            except Exception:
+                logger.exception("metrics reporter failed; continuing")
+
+    thread = threading.Thread(target=_report, daemon=True, name="metrics-reporter")
+    thread.start()
+    logger.info("Emitting serving metric snapshots every %.1fs", interval)
+    return thread
+
+
 def serving_entrypoint(port=None, block=True):
     set_default_serving_env_if_unspecified()
-    logging.basicConfig(level=logging.INFO)
+    setup_main_logger(__name__)
     port = int(port or os.getenv("SAGEMAKER_BIND_TO_PORT", 8080))
     app = build_app()
+    logger.info(
+        "GET /metrics is %s (gate: %s=true)",
+        "enabled" if telemetry.metrics_endpoint_enabled() else "disabled",
+        telemetry.METRICS_ENDPOINT_ENV,
+    )
+    start_metrics_reporter()
     httpd = make_server(
         "0.0.0.0", port, app, server_class=_ThreadedWSGIServer, handler_class=_QuietHandler
     )

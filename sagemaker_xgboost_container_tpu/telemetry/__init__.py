@@ -1,0 +1,40 @@
+"""Unified telemetry layer: registry, spans, stdout records, /metrics.
+
+Import surface for the rest of the container:
+
+    from ..telemetry import REGISTRY            # process-wide registry
+    from ..telemetry import span, PhaseRecorder # phase timing
+    from ..telemetry import emit_metric         # structured stdout records
+    from ..telemetry import instrument_wsgi     # serving middleware
+
+See docs/observability.md for the full metric catalogue and env knobs.
+"""
+
+from .emit import (  # noqa: F401
+    STRUCTURED_METRICS_ENV,
+    emit_metric,
+    snapshot_fields,
+    structured_enabled,
+)
+from .prometheus import CONTENT_TYPE, render_text  # noqa: F401
+from .registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    POW2_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from .spans import (  # noqa: F401
+    PhaseRecorder,
+    active_recorder,
+    pop_recorder,
+    push_recorder,
+    span,
+)
+from .wsgi import (  # noqa: F401
+    METRICS_ENDPOINT_ENV,
+    instrument_wsgi,
+    metrics_endpoint_enabled,
+)

@@ -1,0 +1,241 @@
+"""Process-wide metrics registry: counters, gauges, bounded-bucket histograms.
+
+The reference container's only metric surface was CloudWatch regexes over
+tracker log lines (SURVEY §5). This registry is the in-process source of
+truth both export surfaces read from: the Prometheus text exposition
+(``telemetry/prometheus.py``, served by ``GET /metrics``) and the structured
+JSON stdout records (``telemetry/emit.py``, the CloudWatch metric-definition
+contract).
+
+Design constraints:
+
+* dependency-free — no prometheus_client in the image; stdlib only.
+* thread-safe — serving requests observe from WSGI worker threads while the
+  batcher worker observes dispatches and a reporter thread snapshots.
+* bounded memory — histograms hold fixed bucket counts (no raw samples), so
+  a month of serving traffic costs the same bytes as a minute.
+
+Metric identity is ``(name, sorted(labels))``: ``get``-or-create calls from
+different sites return the same instance, so a reloaded MME model's batcher
+continues its counters instead of zeroing them.
+"""
+
+import bisect
+import threading
+
+# Latency-shaped default buckets, in seconds: 1ms .. 10s + the implicit +Inf.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Size-shaped buckets (rows, bytes, requests-per-batch): powers of two.
+POW2_BUCKETS = tuple(float(2 ** i) for i in range(0, 15))
+
+
+def _label_key(labels):
+    return tuple(sorted((labels or {}).items()))
+
+
+class _Metric:
+    __slots__ = ("name", "labels", "_lock")
+
+    def __init__(self, name, labels):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+    __slots__ = ("_value",)
+
+    def __init__(self, name, labels=None):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up (got %r)" % amount)
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    __slots__ = ("_value",)
+
+    def __init__(self, name, labels=None):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram(_Metric):
+    """Fixed upper-bound buckets + sum/count; quantiles interpolated from the
+    cumulative bucket counts (prometheus ``histogram_quantile`` semantics —
+    an estimate bounded by bucket resolution, not an exact order statistic)."""
+
+    kind = "histogram"
+    __slots__ = ("bounds", "_counts", "_sum", "_count")
+
+    def __init__(self, name, labels=None, buckets=None):
+        super().__init__(name, labels)
+        bounds = tuple(sorted(set(float(b) for b in (buckets or DEFAULT_BUCKETS))))
+        if not bounds:
+            raise ValueError("histogram needs at least one finite bucket bound")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot is +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def snapshot(self):
+        """-> (cumulative_bucket_counts aligned to bounds + [+Inf], sum, count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cumulative = []
+        running = 0
+        for c in counts:
+            running += c
+            cumulative.append(running)
+        return cumulative, s, total
+
+    def quantile(self, q):
+        """Estimate the q-quantile (0..1) by linear interpolation inside the
+        bucket containing it; observations beyond the last finite bound clamp
+        to that bound."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1]")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return float("nan")
+        target = q * total
+        cum = 0.0
+        lower = 0.0
+        for bound, cnt in zip(self.bounds, counts):
+            if cnt and cum + cnt >= target:
+                return lower + (bound - lower) * ((target - cum) / cnt)
+            cum += cnt
+            lower = bound
+        return self.bounds[-1]
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry; the process-wide instance is
+    ``telemetry.REGISTRY``. Tests build private registries for isolation."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families = {}  # name -> (kind, help)
+        self._metrics = {}  # (name, label_key) -> metric
+
+    def _get_or_create(self, kind, name, help_text, labels, **kwargs):
+        key = (name, _label_key(labels))
+        with self._lock:
+            family = self._families.get(name)
+            if family is not None and family[0] != kind:
+                raise ValueError(
+                    "metric {!r} already registered as {} (requested {})".format(
+                        name, family[0], kind
+                    )
+                )
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = self._KINDS[kind](name, labels=labels, **kwargs)
+                self._metrics[key] = metric
+                if family is None:
+                    self._families[name] = (kind, help_text or "")
+            return metric
+
+    def counter(self, name, help="", labels=None):
+        return self._get_or_create("counter", name, help, labels)
+
+    def gauge(self, name, help="", labels=None):
+        return self._get_or_create("gauge", name, help, labels)
+
+    def histogram(self, name, help="", labels=None, buckets=None):
+        return self._get_or_create("histogram", name, help, labels, buckets=buckets)
+
+    def collect(self):
+        """-> [(name, kind, help, [metric, ...])] sorted by name; each family's
+        series sorted by label key (stable exposition output)."""
+        with self._lock:
+            families = dict(self._families)
+            by_name = {}
+            for (name, lk), metric in self._metrics.items():
+                by_name.setdefault(name, []).append((lk, metric))
+        out = []
+        for name in sorted(by_name):
+            kind, help_text = families[name]
+            series = [m for _lk, m in sorted(by_name[name], key=lambda p: p[0])]
+            out.append((name, kind, help_text, series))
+        return out
+
+    def remove_matching(self, label_name, label_value):
+        """Drop every series whose labels carry ``label_name == label_value``.
+
+        Lifecycle hook for label values that come and go (MME model names):
+        without it, model churn on a long-lived endpoint grows the registry —
+        and the /metrics exposition and snapshot records — without bound.
+        Returns the number of series removed.
+        """
+        with self._lock:
+            doomed = [
+                key
+                for key, metric in self._metrics.items()
+                if metric.labels.get(label_name) == label_value
+            ]
+            for key in doomed:
+                del self._metrics[key]
+            return len(doomed)
+
+    def reset(self):
+        """Drop every metric (test isolation only — never during serving)."""
+        with self._lock:
+            self._families.clear()
+            self._metrics.clear()
+
+
+REGISTRY = MetricsRegistry()

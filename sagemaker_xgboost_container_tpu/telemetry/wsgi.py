@@ -1,0 +1,157 @@
+"""WSGI instrumentation middleware + the ``GET /metrics`` route.
+
+Wraps both serving apps (single-model ``make_app`` and the MME manager) so
+every request records, per normalized route:
+
+* ``serving_requests_total{route, code}`` — code collapsed to its class
+  (``2xx``/``4xx``/...) to keep cardinality fixed,
+* ``serving_request_seconds{route}`` — end-to-end latency histogram,
+* ``serving_request_bytes{route}`` — request payload size histogram.
+
+Routes are normalized to a closed set (``/ping``, ``/invocations``,
+``/execution-parameters``, ``/metrics``, ``/models``, ``other``) — raw paths
+(model names, typos, scanners) never become label values.
+
+``/metrics`` is env-gated and OFF by default: SageMaker endpoints only
+expose ``/ping`` + ``/invocations``, and an always-on introspection route
+would leak operational detail on public endpoints. Set
+``SM_SERVING_METRICS=true`` to serve the Prometheus exposition.
+"""
+
+import http.client
+import os
+import time
+
+from .prometheus import CONTENT_TYPE, render_text
+from .registry import REGISTRY
+
+METRICS_ENDPOINT_ENV = "SM_SERVING_METRICS"
+
+_KNOWN_ROUTES = ("/ping", "/invocations", "/execution-parameters", "/metrics")
+
+# 1KB .. 8MB payload buckets (MAX_CONTENT_LENGTH default is 6MB)
+_BYTE_BUCKETS = tuple(float(2 ** i) for i in range(10, 24))
+
+
+def metrics_endpoint_enabled():
+    return os.environ.get(METRICS_ENDPOINT_ENV, "").lower() in ("1", "true")
+
+
+def _route_label(path):
+    if path in _KNOWN_ROUTES:
+        return path
+    if path.startswith("/models"):
+        return "/models"
+    return "other"
+
+
+def _code_class(code):
+    try:
+        return "{}xx".format(int(code) // 100)
+    except (TypeError, ValueError):
+        return "5xx"
+
+
+def instrument_wsgi(app, registry=None):
+    """Wrap ``app`` with request metrics and the /metrics route."""
+    reg = registry or REGISTRY
+
+    # Hot path: resolve each (route, code) handle once and reuse it — the
+    # label space is a closed set, so the cache is bounded and per-request
+    # work is a single dict hit instead of registry RLock + key rebuild.
+    # dict get/set are atomic under the GIL and get-or-create is idempotent,
+    # so a racing double-insert converges on the same metric instance.
+    handles = {}
+
+    def _counter(route, code_class):
+        key = ("c", route, code_class)
+        metric = handles.get(key)
+        if metric is None:
+            metric = handles[key] = reg.counter(
+                "serving_requests_total",
+                help="Requests by route and status class",
+                labels={"route": route, "code": code_class},
+            )
+        return metric
+
+    def _latency(route):
+        key = ("l", route)
+        metric = handles.get(key)
+        if metric is None:
+            metric = handles[key] = reg.histogram(
+                "serving_request_seconds",
+                help="End-to-end request latency",
+                labels={"route": route},
+            )
+        return metric
+
+    def _payload(route):
+        key = ("b", route)
+        metric = handles.get(key)
+        if metric is None:
+            metric = handles[key] = reg.histogram(
+                "serving_request_bytes",
+                help="Request payload size",
+                labels={"route": route},
+                buckets=_BYTE_BUCKETS,
+            )
+        return metric
+
+    def wrapped(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        route = _route_label(path)
+
+        if path == "/metrics" and method == "GET":
+            if not metrics_endpoint_enabled():
+                # indistinguishable from any other unknown route when gated
+                body = b"not found"
+                start_response(
+                    "404 Not Found",
+                    [("Content-Type", "text/plain"),
+                     ("Content-Length", str(len(body)))],
+                )
+                return [body]
+            body = render_text(reg).encode("utf-8")
+            start_response(
+                "200 OK",
+                [("Content-Type", CONTENT_TYPE),
+                 ("Content-Length", str(len(body)))],
+            )
+            _counter(route, "2xx").inc()
+            return [body]
+
+        captured = {}
+
+        def recording_start_response(status, headers, exc_info=None):
+            captured["status"] = status
+            return start_response(status, headers, exc_info)
+
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except (TypeError, ValueError):
+            length = 0
+
+        start = time.perf_counter()
+        try:
+            result = app(environ, recording_start_response)
+        except Exception:
+            _counter(route, "5xx").inc()
+            raise
+        elapsed = time.perf_counter() - start
+
+        status = captured.get("status", "500")
+        _counter(route, _code_class(status.split(" ")[0])).inc()
+        _latency(route).observe(elapsed)
+        if length:
+            _payload(route).observe(length)
+        return result
+
+    return wrapped
+
+
+__all__ = [
+    "instrument_wsgi",
+    "metrics_endpoint_enabled",
+    "METRICS_ENDPOINT_ENV",
+]

@@ -30,6 +30,7 @@ from ..data.readers import (
     validate_data_file_path,
 )
 from ..parallel import distributed
+from ..telemetry import span
 from ..toolkit import exceptions as exc
 from ..toolkit.channels import PIPE_MODE
 from ..models import booster
@@ -119,9 +120,10 @@ def sagemaker_train(
             "automatically — remove this hyperparameter."
         )
 
-    train_dmatrix, val_dmatrix, train_val_dmatrix = get_validated_data_matrices(
-        train_path, val_path, file_type, csv_weights, is_pipe, combine_train_val
-    )
+    with span("data_ingest", emit=True):
+        train_dmatrix, val_dmatrix, train_val_dmatrix = get_validated_data_matrices(
+            train_path, val_path, file_type, csv_weights, is_pipe, combine_train_val
+        )
     missing_validation_data = validation_channel and not val_dmatrix
 
     train_args = dict(
@@ -371,8 +373,9 @@ def train_job(
                 save_model_on_termination=save_model_on_termination,
                 is_master=is_master,
                 num_round=num_round,
+                num_rows=train_dmatrix.num_row,
             )
-            with xla_trace():
+            with xla_trace(), span("train", emit=True):
                 bst = booster.train(
                     train_cfg,
                     train_dmatrix,
@@ -446,6 +449,7 @@ def train_job(
                         is_master=is_master,
                         fold=len(bst),
                         num_round=num_round,
+                        num_rows=cv_train.num_row,
                     )
 
                     class _EvalsRecorder:
@@ -492,15 +496,20 @@ def train_job(
 
     os.makedirs(model_dir, exist_ok=True)
     if is_master:
-        if not isinstance(bst, list):
-            model_location = os.path.join(model_dir, MODEL_NAME)
-            bst.save_model(model_location)
-            logger.debug("Stored trained model at %s", model_location)
-        else:
-            for fold, fold_booster in enumerate(bst):
-                model_location = os.path.join(model_dir, "{}-{}".format(MODEL_NAME, fold))
-                fold_booster.save_model(model_location)
-                logger.debug("Stored trained model %d at %s", fold, model_location)
+        with span("model_save", emit=True):
+            if not isinstance(bst, list):
+                model_location = os.path.join(model_dir, MODEL_NAME)
+                bst.save_model(model_location)
+                logger.debug("Stored trained model at %s", model_location)
+            else:
+                for fold, fold_booster in enumerate(bst):
+                    model_location = os.path.join(
+                        model_dir, "{}-{}".format(MODEL_NAME, fold)
+                    )
+                    fold_booster.save_model(model_location)
+                    logger.debug(
+                        "Stored trained model %d at %s", fold, model_location
+                    )
 
 
 def _try_parallel_cv(

@@ -13,9 +13,46 @@ import os
 import signal
 
 from ..constants import MODEL_NAME, XGB_MAXIMIZE_METRICS
+from ..telemetry import span
 from . import checkpointing, train_utils
 
 logger = logging.getLogger(__name__)
+
+
+class _TimedCallback:
+    """Delegate that records the inner callback's after_iteration wall time
+    as a named phase (feeding the per-round ``phases_ms`` breakdown emitted
+    by RoundTimer and the ``training_phase_seconds`` histogram). Transparent
+    for the booster protocol AND for attribute introspection: train loops
+    duck-type callbacks (e.g. dart's ``getattr(cb, "save_best", False)``
+    rejection guard), so unknown attributes forward to ``inner``."""
+
+    def __init__(self, inner, phase):
+        self.inner = inner
+        self.phase = phase
+
+    def __getattr__(self, name):
+        # only reached for attributes not defined on the wrapper itself;
+        # guard 'inner' against recursion when called pre-__init__ (copy etc.)
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def before_training(self, model):
+        if hasattr(self.inner, "before_training"):
+            return self.inner.before_training(model)
+        return model
+
+    def after_iteration(self, model, epoch, evals_log):
+        if not hasattr(self.inner, "after_iteration"):
+            return False
+        with span(self.phase):
+            return self.inner.after_iteration(model, epoch, evals_log)
+
+    def after_training(self, model):
+        if hasattr(self.inner, "after_training"):
+            return self.inner.after_training(model)
+        return model
 
 
 class EvaluationMonitor:
@@ -108,6 +145,7 @@ def get_callbacks(
     is_master,
     fold=None,
     num_round=None,
+    num_rows=None,
 ):
     """-> (xgb_model path or None, start iteration, callback list).
 
@@ -122,36 +160,54 @@ def get_callbacks(
         logger.info("Checkpoint loaded from %s", xgb_model)
         logger.info("Resuming from iteration %s", iteration)
 
-    callbacks = [EvaluationMonitor()]
-
-    if os.environ.get("SM_ROUND_TIMING", "").lower() in ("1", "true"):
-        from .profiling import RoundTimer
-
-        callbacks.append(RoundTimer())
+    callbacks = [_TimedCallback(EvaluationMonitor(), "eval_monitor")]
 
     if checkpoint_dir and is_master:
         callbacks.append(
-            checkpointing.SaveCheckpointCallBack(
-                checkpoint_dir, start_iteration=iteration, num_round=num_round
+            _TimedCallback(
+                checkpointing.SaveCheckpointCallBack(
+                    checkpoint_dir, start_iteration=iteration, num_round=num_round
+                ),
+                "checkpoint",
             )
         )
 
     if save_model_on_termination == "true" and is_master:
         model_name = "{}-{}".format(MODEL_NAME, fold) if fold is not None else MODEL_NAME
         callbacks.append(
-            checkpointing.SaveIntermediateModelCallBack(model_dir, model_name, is_master)
+            _TimedCallback(
+                checkpointing.SaveIntermediateModelCallBack(
+                    model_dir, model_name, is_master
+                ),
+                "intermediate_save",
+            )
         )
         add_sigterm_handler(model_dir, is_master)
 
     if early_stopping_data_name and early_stopping_metric and early_stopping_rounds:
         callbacks.append(
-            EarlyStopping(
-                rounds=early_stopping_rounds,
-                data_name=early_stopping_data_name,
-                metric_name=early_stopping_metric,
-                maximize=early_stopping_metric in XGB_MAXIMIZE_METRICS,
-                save_best=is_master,
+            _TimedCallback(
+                EarlyStopping(
+                    rounds=early_stopping_rounds,
+                    data_name=early_stopping_data_name,
+                    metric_name=early_stopping_metric,
+                    maximize=early_stopping_metric in XGB_MAXIMIZE_METRICS,
+                    save_best=is_master,
+                ),
+                "early_stopping",
             )
         )
+
+    # LAST: each round's record must drain the phases the callbacks above
+    # recorded for that same round. Per-round log lines stay opt-in
+    # (SM_ROUND_TIMING); the structured record is the telemetry contract.
+    from .profiling import RoundTimer
+
+    round_timing = os.environ.get("SM_ROUND_TIMING", "").lower() in ("1", "true")
+    callbacks.append(
+        RoundTimer(
+            num_rows=num_rows, log_every=10 if round_timing else 0, fold=fold
+        )
+    )
 
     return xgb_model, iteration, callbacks

@@ -178,7 +178,9 @@ def _write_failure_file(message):
 
 
 def main():
-    logging.basicConfig(level=logging.INFO)
+    from ..utils.logging_config import setup_main_logger
+
+    setup_main_logger(__name__)  # honors SAGEMAKER_CONTAINER_LOG_LEVEL
     try:
         derive_sm_env()
         train()

@@ -1,44 +1,113 @@
 """First-class training profiling (SURVEY.md §5: the reference's only tracing
 was wall-clock tracker logs; smdebug was installed but disabled).
 
-Two light-weight hooks:
+Three light-weight hooks:
 
-* ``RoundTimer`` — per-round wall time + throughput, logged every
-  ``log_every`` rounds and summarized at end of training.
+* ``RoundTimer`` — per-round wall time + throughput. Always feeds the
+  telemetry layer: every round emits one structured JSON stdout record
+  (``training.round``) carrying the round latency and a per-phase breakdown
+  (the span recorder drains into it), and observes the
+  ``training_round_seconds`` registry histogram. Human-readable per-round
+  log lines stay opt-in via ``log_every`` (SM_ROUND_TIMING); the end-of-run
+  summary reports mean, p50, and p95.
 * ``xla_trace`` — context manager around training that writes a JAX profiler
   trace (TensorBoard-viewable) when ``SM_PROFILER_TRACE_DIR`` is set.
+* the span API (``telemetry.span``) — algorithm_train wraps data ingest,
+  the boosting loop, and model save in named phases.
 """
 
 import contextlib
 import logging
+import math
 import os
 import time
+
+from ..telemetry import REGISTRY, emit_metric, pop_recorder, push_recorder
 
 logger = logging.getLogger(__name__)
 
 TRACE_DIR_ENV = "SM_PROFILER_TRACE_DIR"
 
+ROUND_HISTOGRAM = "training_round_seconds"
+
+
+def percentile(values, q):
+    """Exact linear-interpolation percentile of an unsorted list (q in 0..1)."""
+    if not values:
+        return float("nan")
+    ordered = sorted(values)
+    pos = (len(ordered) - 1) * q
+    lo = math.floor(pos)
+    hi = math.ceil(pos)
+    if lo == hi:
+        return ordered[lo]
+    return ordered[lo] + (ordered[hi] - ordered[lo]) * (pos - lo)
+
 
 class RoundTimer:
-    def __init__(self, num_rows=None, log_every=10):
+    """Per-round timing callback; rides the standard booster protocol.
+
+    ``emit_structured`` controls the per-round ``training.round`` stdout
+    record (default on; SM_STRUCTURED_METRICS=false silences it globally).
+    ``log_every=0`` disables the human-readable per-round log lines while
+    keeping the structured emission and the end-of-run summary.
+    ``fold`` tags every record in k-fold CV runs (each fold trains its own
+    callback stack, so per-epoch records from different folds must stay
+    distinguishable for the CloudWatch regexes).
+    """
+
+    def __init__(self, num_rows=None, log_every=10, emit_structured=True, fold=None):
         self.num_rows = num_rows
         self.log_every = log_every
+        self.emit_structured = emit_structured
+        self.fold = fold
         self._last = None
         self._times = []
+        self._recorder = None
 
     def before_training(self, model):
         self._last = time.perf_counter()
+        # collect span phases (checkpoint saves, eval monitor, ...) per round;
+        # popped in after_training. Thread-local, so parallel fold loops on
+        # other threads never cross-talk.
+        self._recorder = push_recorder()
         return model
 
     def after_iteration(self, model, epoch, evals_log):
         now = time.perf_counter()
         if self._last is not None:
-            self._times.append(now - self._last)
+            elapsed = now - self._last
+            self._times.append(elapsed)
+            REGISTRY.histogram(
+                ROUND_HISTOGRAM, help="Boosting round wall time"
+            ).observe(elapsed)
+            phases = self._recorder.drain() if self._recorder is not None else {}
+            if self.emit_structured:
+                # callback work is measured by its spans; the remainder of the
+                # round is device compute: binning (first round), tree build,
+                # eval. One record per round — the CloudWatch-regex contract.
+                overhead = sum(phases.values())
+                phases_ms = {
+                    k: round(v * 1000, 3) for k, v in sorted(phases.items())
+                }
+                phases_ms["build_eval"] = round(
+                    max(elapsed - overhead, 0.0) * 1000, 3
+                )
+                fields = {
+                    "round": epoch,
+                    "round_ms": round(elapsed * 1000, 3),
+                    "phases_ms": phases_ms,
+                }
+                if self.fold is not None:
+                    fields["fold"] = self.fold
+                if self.num_rows and elapsed > 0:
+                    fields["rows_per_sec"] = round(self.num_rows / elapsed, 1)
+                emit_metric("training.round", **fields)
             if self.log_every and (epoch + 1) % self.log_every == 0:
                 recent = self._times[-self.log_every :]
                 mean = sum(recent) / len(recent)
                 msg = "round {}: {:.1f} ms/round".format(epoch, mean * 1000)
-                if self.num_rows:
+                if self.num_rows and mean > 0:
                     msg += " ({:.2f}M rows/sec)".format(
                         self.num_rows / mean / 1e6
                     )
@@ -47,14 +116,34 @@ class RoundTimer:
         return False
 
     def after_training(self, model):
+        if self._recorder is not None:
+            pop_recorder(self._recorder)
+            self._recorder = None
         if self._times:
             total = sum(self._times)
+            p50 = percentile(self._times, 0.5)
+            p95 = percentile(self._times, 0.95)
+            # guard: a ~0 total (trivial data, coarse clocks) must not divide
+            rate = len(self._times) / total if total > 0 else float("inf")
             logger.info(
-                "trained %d rounds in %.2fs (%.2f rounds/sec)",
+                "trained %d rounds in %.2fs (%.2f rounds/sec, "
+                "p50 %.1f ms, p95 %.1f ms)",
                 len(self._times),
                 total,
-                len(self._times) / total,
+                rate,
+                p50 * 1000,
+                p95 * 1000,
             )
+            if self.emit_structured:
+                fields = {
+                    "rounds": len(self._times),
+                    "total_s": round(total, 3),
+                    "p50_ms": round(p50 * 1000, 3),
+                    "p95_ms": round(p95 * 1000, 3),
+                }
+                if self.fold is not None:
+                    fields["fold"] = self.fold
+                emit_metric("training.summary", **fields)
         return model
 
 

@@ -1,7 +1,27 @@
-"""Console logging configuration (reference algorithm_mode/integration.py:16-52)."""
+"""Console logging configuration (reference algorithm_mode/integration.py:16-52).
+
+The root level honors ``SAGEMAKER_CONTAINER_LOG_LEVEL`` (reference parity:
+the platform sets it from the Estimator's ``container_log_level``, as either
+a name like ``DEBUG`` or a stdlib numeric level like ``10``); unset or
+unparseable values fall back to INFO.
+"""
 
 import logging
 import logging.config
+import os
+
+LOG_LEVEL_ENV = "SAGEMAKER_CONTAINER_LOG_LEVEL"
+
+
+def _resolve_level():
+    raw = os.environ.get(LOG_LEVEL_ENV, "").strip()
+    if not raw:
+        return "INFO"
+    if raw.isdigit():
+        return int(raw)
+    level = logging.getLevelName(raw.upper())
+    # getLevelName returns the string "Level <raw>" for unknown names
+    return raw.upper() if isinstance(level, int) else "INFO"
 
 
 def setup_main_logger(name):
@@ -23,7 +43,7 @@ def setup_main_logger(name):
                     "stream": "ext://sys.stdout",
                 }
             },
-            "root": {"level": "INFO", "handlers": ["console"]},
+            "root": {"level": _resolve_level(), "handlers": ["console"]},
         }
     )
     return logging.getLogger(name)

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Static check: no ``print(`` in the package outside the explicit allowlist.
+
+Telemetry must flow through the registry/logger/emit layer — stray prints
+bypass the CloudWatch metric-definition contract and pollute the HPO stdout
+scrape surface. The allowlist names the files whose prints ARE a stdout
+contract:
+
+* training/callbacks.py      — EvaluationMonitor HPO eval lines
+* training/algorithm_train.py — CV metric lines (same HPO regex contract)
+* version_contract.py        — CLI verdict for the image build
+* telemetry/emit.py          — the structured-record sink itself (uses
+  sys.stdout.write, listed defensively)
+
+Detection is AST-based (calls to the ``print`` builtin), so strings and
+comments mentioning print() don't trip it. Exit 0 clean, 1 with findings,
+2 on unparseable files. Wired into tox (fast/full) and the tier-1 suite
+(tests/test_telemetry.py).
+"""
+
+import ast
+import os
+import sys
+
+PACKAGE = "sagemaker_xgboost_container_tpu"
+
+ALLOWLIST = {
+    "training/callbacks.py",
+    "training/algorithm_train.py",
+    "version_contract.py",
+    "telemetry/emit.py",
+}
+
+
+def find_print_calls(source, filename):
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        raise RuntimeError("cannot parse {}: {}".format(filename, e))
+    calls = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            calls.append(node.lineno)
+    return calls
+
+
+def check(repo_root):
+    pkg_root = os.path.join(repo_root, PACKAGE)
+    findings = []
+    errors = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+            if rel in ALLOWLIST:
+                continue
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            try:
+                for lineno in find_print_calls(source, path):
+                    findings.append("{}/{}:{}".format(PACKAGE, rel, lineno))
+            except RuntimeError as e:
+                errors.append(str(e))
+    return findings, errors
+
+
+def main(argv=None):
+    repo_root = (argv or sys.argv[1:] or [None])[0] or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    findings, errors = check(repo_root)
+    for err in errors:
+        sys.stderr.write(err + "\n")
+    for finding in findings:
+        sys.stderr.write(
+            "print() outside allowlist: {} (route output through "
+            "telemetry.emit_metric or a logger)\n".format(finding)
+        )
+    if errors:
+        return 2
+    if findings:
+        return 1
+    sys.stderr.write("check_no_print: OK\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

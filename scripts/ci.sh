@@ -28,6 +28,12 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
+# static no-print gate (tox.ini parity): telemetry goes through the
+# registry/logger, not stray stdout writes
+python "$REPO/scripts/check_no_print.py" || {
+  echo "CI $TIER TIER FAILED (check_no_print)"; exit 1;
+}
+
 case "$TIER" in
   fast)
     python -m pytest tests/ -q -x --ignore=tests/test_training_e2e.py \

@@ -207,12 +207,15 @@ def test_get_callbacks_assembly_and_resume(tmp_path):
         num_round=10,
     )
     assert xgb_model.endswith("xgboost-checkpoint.4") and iteration == 5
-    kinds = [type(cb).__name__ for cb in cbs]
+    # telemetry wraps each callback in a timing delegate; unwrap for identity
+    inner = [getattr(cb, "inner", cb) for cb in cbs]
+    kinds = [type(cb).__name__ for cb in inner]
     assert kinds[0] == "EvaluationMonitor"
     assert "SaveCheckpointCallBack" in kinds
-    es = [cb for cb in cbs if isinstance(cb, EarlyStopping)][0]
+    assert kinds[-1] == "RoundTimer"  # last: drains per-round phase spans
+    es = [cb for cb in inner if isinstance(cb, EarlyStopping)][0]
     assert es.maximize is True  # auc maximizes
-    for cb in cbs:
+    for cb in inner:
         if hasattr(cb, "stop"):
             cb.stop()
 
@@ -227,7 +230,7 @@ def test_get_callbacks_worker_gets_no_savers(tmp_path):
         save_model_on_termination="true",
         is_master=False,
     )
-    kinds = [type(cb).__name__ for cb in cbs]
+    kinds = [type(getattr(cb, "inner", cb)).__name__ for cb in cbs]
     assert "SaveCheckpointCallBack" not in kinds
     assert "SaveIntermediateModelCallBack" not in kinds
 

@@ -593,17 +593,43 @@ def main():
     )
     session = _TrainingSession(config, dtrain, [], forest)
 
-    done = 0
-    while done < WARMUP_ROUNDS:
-        done += len(session.run_rounds()[0])
-    jax.block_until_ready(session.margins)
+    # the round-latency distribution rides the same telemetry registry the
+    # trainer uses (training_round_seconds / training_phase_seconds), so the
+    # bench line carries registry-derived p50/p95 + a phase breakdown, not
+    # just the mean — BENCH_*.json trajectory entries get a real shape
+    from sagemaker_xgboost_container_tpu.telemetry import REGISTRY, span
+    from sagemaker_xgboost_container_tpu.training.profiling import ROUND_HISTOGRAM
+
+    round_hist = REGISTRY.histogram(ROUND_HISTOGRAM, help="Boosting round wall time")
+
+    with span("warmup"):
+        done = 0
+        while done < WARMUP_ROUNDS:
+            done += len(session.run_rounds()[0])
+        jax.block_until_ready(session.margins)
 
     start = time.perf_counter()
     done = 0
-    while done < BENCH_ROUNDS:
-        done += len(session.run_rounds()[0])
-    jax.block_until_ready(session.margins)
+    with span("measure"):
+        # block per dispatch (not once at the end) so per-round latency is
+        # observable; with K rounds per dispatch the extra syncs are ~2 of
+        # BENCH_ROUNDS/K and amortize to noise
+        while done < BENCH_ROUNDS:
+            t0 = time.perf_counter()
+            n = len(session.run_rounds()[0])
+            jax.block_until_ready(session.margins)
+            dt = time.perf_counter() - t0
+            for _ in range(n):
+                round_hist.observe(dt / max(n, 1))
+            done += n
     elapsed = time.perf_counter() - start
+
+    phases_ms = {}
+    for name, kind, _help, series in REGISTRY.collect():
+        if name == "training_phase_seconds" and kind == "histogram":
+            for metric in series:
+                phase = metric.labels.get("phase", "unknown")
+                phases_ms[phase] = round(metric.sum * 1000, 3)
 
     rounds_per_sec = done / elapsed
     shape_note = (
@@ -620,6 +646,9 @@ def main():
                 "value": round(rounds_per_sec, 3),
                 "unit": "rounds/sec",
                 "vs_baseline": round(rounds_per_sec / NORTH_STAR_ROUNDS_PER_SEC, 3),
+                "p50_ms": round(round_hist.quantile(0.5) * 1000, 3),
+                "p95_ms": round(round_hist.quantile(0.95) * 1000, 3),
+                "phases_ms": phases_ms,
             }
         )
     )

@@ -54,7 +54,7 @@ def wait_hostname_resolution(sm_hosts, max_wait_seconds=900):
                 delay *= 2
 
 
-def _recv_exact(sock, n):
+def recv_exact(sock, n):
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -64,14 +64,31 @@ def _recv_exact(sock, n):
     return buf
 
 
-def _send_msg(sock, obj):
+def frame_message(obj):
+    """Length-prefixed JSON framing: ``<u32 little-endian length><payload>``.
+
+    The one wire format shared by the rendezvous allgather below and the
+    cluster telemetry heartbeats (telemetry/cluster.py) — a single framing
+    implementation keeps the two protocols trivially interoperable and
+    testable off-socket.
+    """
     payload = json.dumps(obj).encode()
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+    return struct.pack("<I", len(payload)) + payload
 
 
-def _recv_msg(sock):
-    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
-    return json.loads(_recv_exact(sock, length).decode())
+def send_message(sock, obj):
+    sock.sendall(frame_message(obj))
+
+
+def recv_message(sock):
+    (length,) = struct.unpack("<I", recv_exact(sock, 4))
+    return json.loads(recv_exact(sock, length).decode())
+
+
+# historical private names, kept for in-repo callers
+_recv_exact = recv_exact
+_send_msg = send_message
+_recv_msg = recv_message
 
 
 class Cluster:

@@ -20,7 +20,7 @@ import time
 import numpy as np
 
 from ..models.forest import _host_predict_rows
-from ..telemetry import POW2_BUCKETS, REGISTRY
+from ..telemetry import POW2_BUCKETS, REGISTRY, get_request_id
 
 logger = logging.getLogger(__name__)
 
@@ -116,6 +116,7 @@ class PredictBatcher:
         # the same instant, and the log-once guard must hold exactly then
         self._timeout_log_lock = threading.Lock()
         self._timeout_logged = False
+        self._rejection_logged = False
         # bounded queue -> the limit is atomic (put_nowait raises Full);
         # a qsize() check-then-put would race under concurrent WSGI threads.
         # Clamped to >=1 when bounded: Queue(maxsize=0) means UNLIMITED in
@@ -157,6 +158,16 @@ class PredictBatcher:
             self._queue.put_nowait(pending)
         except queue.Full:
             self._m_rejected.inc()
+            with self._timeout_log_lock:
+                should_log, self._rejection_logged = not self._rejection_logged, True
+            if should_log:
+                logger.warning(
+                    "rejecting prediction (request %s): job queue full (%s "
+                    "pending). Further rejections are counted in "
+                    "batcher_rejected_total without logging.",
+                    get_request_id() or "untracked",
+                    self.max_queue,
+                )
             raise JobQueueFull(
                 "job queue full ({} pending)".format(self.max_queue)
             )
@@ -172,10 +183,11 @@ class PredictBatcher:
                 should_log, self._timeout_logged = not self._timeout_logged, True
             if should_log:
                 logger.warning(
-                    "prediction timed out after %.1fs in the batch queue; the "
-                    "batch worker may still dispatch the abandoned rows. "
-                    "Further timeouts are counted in batcher_queue_timeout_total "
-                    "without logging.",
+                    "prediction (request %s) timed out after %.1fs in the "
+                    "batch queue; the batch worker may still dispatch the "
+                    "abandoned rows. Further timeouts are counted in "
+                    "batcher_queue_timeout_total without logging.",
+                    get_request_id() or "untracked",
                     timeout,
                 )
             raise TimeoutError("prediction timed out in the batch queue")

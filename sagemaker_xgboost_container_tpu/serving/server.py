@@ -20,12 +20,12 @@ import os
 import signal
 import sys
 import threading
-import time
 from socketserver import ThreadingMixIn
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from .. import constants
 from .. import telemetry
+from ..utils.envconfig import env_float
 from ..utils.logging_config import setup_main_logger
 from .app import ScoringService, make_app
 from .mme import make_mme_app
@@ -95,54 +95,78 @@ def build_app():
     return make_app(ScoringService(model_dir), hooks=hooks)
 
 
-def start_metrics_reporter(interval=None, registry=None):
-    """Daemon thread emitting one ``serving.snapshot`` structured record every
-    ``SM_METRICS_EMIT_INTERVAL_S`` seconds — the CloudWatch-scrapable view of
-    serving metrics for fleets without a Prometheus scraper. Off by default
-    (interval unset/0). Returns the thread, or None when disabled."""
-    if interval is None:
-        try:
-            interval = float(os.environ.get(METRICS_INTERVAL_ENV, "0") or 0)
-        except ValueError:
-            logger.warning("invalid %s; metrics reporter disabled", METRICS_INTERVAL_ENV)
-            return None
-    if interval <= 0:
-        return None
-    reg = registry or telemetry.REGISTRY
+class MetricsReporter:
+    """Stop-able periodic ``serving.snapshot`` emitter.
 
-    def _report():
-        while True:
-            time.sleep(interval)
+    ``Event.wait(interval)`` instead of a bare ``time.sleep`` so the loop is
+    killable: tests and graceful shutdown call :meth:`stop` and the thread
+    exits within one wait, instead of leaking an unkillable daemon per
+    server start."""
+
+    def __init__(self, interval, registry):
+        self.interval = interval
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="metrics-reporter"
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
             try:
                 telemetry.emit_metric(
-                    "serving.snapshot", **telemetry.snapshot_fields(reg)
+                    "serving.snapshot", **telemetry.snapshot_fields(self._registry)
                 )
             except Exception:
                 logger.exception("metrics reporter failed; continuing")
 
-    thread = threading.Thread(target=_report, daemon=True, name="metrics-reporter")
-    thread.start()
+
+def start_metrics_reporter(interval=None, registry=None):
+    """Start a daemon emitting one ``serving.snapshot`` structured record every
+    ``SM_METRICS_EMIT_INTERVAL_S`` seconds — the CloudWatch-scrapable view of
+    serving metrics for fleets without a Prometheus scraper. Off by default
+    (interval unset/0/malformed — malformed values warn once via envconfig).
+    Returns a :class:`MetricsReporter` stop handle, or None when disabled."""
+    if interval is None:
+        interval = env_float(METRICS_INTERVAL_ENV, 0.0, minimum=0.0)
+    if interval <= 0:
+        return None
+    reporter = MetricsReporter(interval, registry or telemetry.REGISTRY).start()
     logger.info("Emitting serving metric snapshots every %.1fs", interval)
-    return thread
+    return reporter
 
 
 def serving_entrypoint(port=None, block=True):
     set_default_serving_env_if_unspecified()
     setup_main_logger(__name__)
     port = int(port or os.getenv("SAGEMAKER_BIND_TO_PORT", 8080))
+    # device-runtime gauges (XLA compile count/seconds, RSS, live device
+    # bytes) feed /metrics and the snapshot records from serving startup on
+    telemetry.register_runtime_gauges()
     app = build_app()
     logger.info(
         "GET /metrics is %s (gate: %s=true)",
         "enabled" if telemetry.metrics_endpoint_enabled() else "disabled",
         telemetry.METRICS_ENDPOINT_ENV,
     )
-    start_metrics_reporter()
+    reporter = start_metrics_reporter()
     httpd = make_server(
         "0.0.0.0", port, app, server_class=_ThreadedWSGIServer, handler_class=_QuietHandler
     )
 
     def _shutdown(signo, frame):
         logger.info("Received signal %s, shutting down", signo)
+        if reporter is not None:
+            reporter.stop(timeout=2.0)
         raise SystemExit(0)
 
     signal.signal(signal.SIGTERM, _shutdown)

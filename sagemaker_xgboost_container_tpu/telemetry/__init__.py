@@ -1,4 +1,5 @@
-"""Unified telemetry layer: registry, spans, stdout records, /metrics.
+"""Unified telemetry layer: registry, spans, stdout records, /metrics,
+and the multi-host cluster plane.
 
 Import surface for the rest of the container:
 
@@ -6,10 +7,26 @@ Import surface for the rest of the container:
     from ..telemetry import span, PhaseRecorder # phase timing
     from ..telemetry import emit_metric         # structured stdout records
     from ..telemetry import instrument_wsgi     # serving middleware
+    from ..telemetry import start_cluster_telemetry  # heartbeats + rank-0 agg
+    from ..telemetry import register_runtime_gauges  # XLA/RSS/device gauges
+    from ..telemetry import get_request_id      # serving request correlation
 
 See docs/observability.md for the full metric catalogue and env knobs.
 """
 
+from .cluster import (  # noqa: F401
+    CLUSTER_METRICS_ENV,
+    HEARTBEAT_INTERVAL_ENV,
+    ROUND_STATE,
+    refresh_runtime_gauges,
+    register_runtime_gauges,
+    start_cluster_telemetry,
+)
+from .correlation import (  # noqa: F401
+    REQUEST_ID_HEADER,
+    RequestIdFilter,
+    get_request_id,
+)
 from .emit import (  # noqa: F401
     STRUCTURED_METRICS_ENV,
     emit_metric,
@@ -25,6 +42,7 @@ from .registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
     REGISTRY,
+    percentile,
 )
 from .spans import (  # noqa: F401
     PhaseRecorder,

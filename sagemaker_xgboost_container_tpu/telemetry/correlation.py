@@ -1,0 +1,98 @@
+"""Per-request correlation IDs for the serving path.
+
+A slow invocation on a busy endpoint is currently untraceable: the WSGI
+access log, the batcher's timeout warning, and the response the client saw
+have nothing in common to join on. This module gives every request one ID:
+
+* honored from the client when present — ``X-Request-Id`` directly, or a
+  ``request_id=``/``trace_id=`` pair inside
+  ``X-Amzn-SageMaker-Custom-Attributes`` (the SageMaker-blessed passthrough
+  header for invocation metadata);
+* generated otherwise (uuid4 hex);
+* stored in a thread-local for the duration of the request (the threaded
+  WSGI server runs one request per thread, and the batcher's timeout/
+  rejection warnings fire on the caller's — i.e. the request's — thread);
+* echoed back in the ``X-Request-Id`` response header;
+* attached to every log record emitted on the request thread via
+  :class:`RequestIdFilter` (installed by ``setup_main_logger``).
+"""
+
+import logging
+import re
+import threading
+import uuid
+
+REQUEST_ID_HEADER = "X-Request-Id"
+CUSTOM_ATTRIBUTES_HEADER = "X-Amzn-SageMaker-Custom-Attributes"
+
+# WSGI environ keys for the two honored headers
+_ENV_REQUEST_ID = "HTTP_X_REQUEST_ID"
+_ENV_CUSTOM_ATTRIBUTES = "HTTP_X_AMZN_SAGEMAKER_CUSTOM_ATTRIBUTES"
+
+# IDs become log fields and response headers: restrict to a safe charset and
+# a bounded length so a hostile header can't inject log lines or bloat them
+_SAFE_ID = re.compile(r"[^A-Za-z0-9._-]")
+_MAX_ID_LEN = 64
+
+_CUSTOM_ATTR_KEYS = ("request_id", "trace_id")
+
+_tls = threading.local()
+
+
+def new_request_id():
+    return uuid.uuid4().hex
+
+
+def _sanitize(raw):
+    if not raw:
+        return None
+    cleaned = _SAFE_ID.sub("", str(raw).strip())[:_MAX_ID_LEN]
+    return cleaned or None
+
+
+def extract_request_id(environ):
+    """Resolve the request ID for a WSGI request: honor the client's when
+    present, generate otherwise. Always returns a non-empty safe string."""
+    rid = _sanitize(environ.get(_ENV_REQUEST_ID))
+    if rid:
+        return rid
+    attrs = environ.get(_ENV_CUSTOM_ATTRIBUTES, "")
+    if attrs:
+        for part in attrs.split(","):
+            key, _, value = part.partition("=")
+            if key.strip().lower() in _CUSTOM_ATTR_KEYS:
+                rid = _sanitize(value)
+                if rid:
+                    return rid
+    return new_request_id()
+
+
+def set_request_id(rid):
+    _tls.request_id = rid
+
+
+def get_request_id():
+    """The current thread's request ID, or None outside a request."""
+    return getattr(_tls, "request_id", None)
+
+
+def clear_request_id():
+    _tls.request_id = None
+
+
+class RequestIdFilter(logging.Filter):
+    """Attach the active request ID to log records.
+
+    Sets ``record.request_id`` (always, ``-`` outside a request) for
+    structured formatters, and appends ``[rid=...]`` to the message when a
+    request is active so the default console format carries it without a
+    format-string change. Idempotent across multiple handlers.
+    """
+
+    def filter(self, record):
+        rid = get_request_id()
+        record.request_id = rid or "-"
+        if rid and not getattr(record, "_rid_tagged", False):
+            record._rid_tagged = True
+            record.msg = "{} [rid={}]".format(record.msg, rid)
+        return True

@@ -12,9 +12,10 @@ eval-line contract (``[<iter>]\\t<data>-<metric>:<value>``).
 """
 
 import json
-import os
 import sys
 import threading
+
+from ..utils.envconfig import env_bool
 
 STRUCTURED_METRICS_ENV = "SM_STRUCTURED_METRICS"
 
@@ -22,11 +23,7 @@ _write_lock = threading.Lock()
 
 
 def structured_enabled():
-    return os.environ.get(STRUCTURED_METRICS_ENV, "true").lower() not in (
-        "0",
-        "false",
-        "off",
-    )
+    return env_bool(STRUCTURED_METRICS_ENV, True)
 
 
 def _jsonable(value):

@@ -41,6 +41,25 @@ def _label_str(labels, extra=None):
     return "{" + inner + "}"
 
 
+def exposition_response(registry, refresh=None):
+    """-> ``(status, headers, body_bytes)`` for a ``GET /metrics`` response.
+
+    The one scrape path shared by the serving middleware (telemetry/wsgi.py)
+    and the cluster plane's standalone server (telemetry/cluster.py), so
+    exposition behavior cannot diverge between the two surfaces. ``refresh``
+    (if given) runs first — sampled gauges (RSS, device bytes) update at
+    scrape time, event-driven ones are already current.
+    """
+    if refresh is not None:
+        refresh(registry)
+    body = render_text(registry).encode("utf-8")
+    return (
+        "200 OK",
+        [("Content-Type", CONTENT_TYPE), ("Content-Length", str(len(body)))],
+        body,
+    )
+
+
 def render_text(registry):
     """Render every family in ``registry`` to the exposition text."""
     lines = []

@@ -21,6 +21,7 @@ continues its counters instead of zeroing them.
 """
 
 import bisect
+import math
 import threading
 
 # Latency-shaped default buckets, in seconds: 1ms .. 10s + the implicit +Inf.
@@ -30,6 +31,29 @@ DEFAULT_BUCKETS = (
 
 # Size-shaped buckets (rows, bytes, requests-per-batch): powers of two.
 POW2_BUCKETS = tuple(float(2 ** i) for i in range(0, 15))
+
+
+def percentile(values, q):
+    """Exact linear-interpolation percentile of an unsorted list (q in 0..1).
+
+    The canonical quantile implementation for *raw-sample* collections
+    (RoundTimer's per-round times, cluster round states). The histogram
+    classes below interpolate inside fixed buckets instead — an estimate
+    bounded by bucket resolution; for samples inside the finite bucket
+    range the two agree to within one bucket width (property-tested in
+    tests/test_telemetry.py).
+    """
+    if not values:
+        return float("nan")
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("quantile must be in [0, 1]")
+    ordered = sorted(values)
+    pos = (len(ordered) - 1) * q
+    lo = math.floor(pos)
+    hi = math.ceil(pos)
+    if lo == hi:
+        return ordered[lo]
+    return ordered[lo] + (ordered[hi] - ordered[lo]) * (pos - lo)
 
 
 def _label_key(labels):

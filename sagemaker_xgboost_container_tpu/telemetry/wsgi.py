@@ -19,10 +19,15 @@ would leak operational detail on public endpoints. Set
 """
 
 import http.client
-import os
 import time
 
-from .prometheus import CONTENT_TYPE, render_text
+from ..utils.envconfig import env_bool
+from .correlation import (
+    REQUEST_ID_HEADER,
+    clear_request_id,
+    extract_request_id,
+    set_request_id,
+)
 from .registry import REGISTRY
 
 METRICS_ENDPOINT_ENV = "SM_SERVING_METRICS"
@@ -34,7 +39,7 @@ _BYTE_BUCKETS = tuple(float(2 ** i) for i in range(10, 24))
 
 
 def metrics_endpoint_enabled():
-    return os.environ.get(METRICS_ENDPOINT_ENV, "").lower() in ("1", "true")
+    return env_bool(METRICS_ENDPOINT_ENV, False)
 
 
 def _route_label(path):
@@ -112,19 +117,27 @@ def instrument_wsgi(app, registry=None):
                      ("Content-Length", str(len(body)))],
                 )
                 return [body]
-            body = render_text(reg).encode("utf-8")
-            start_response(
-                "200 OK",
-                [("Content-Type", CONTENT_TYPE),
-                 ("Content-Length", str(len(body)))],
+            from .cluster import refresh_runtime_gauges
+            from .prometheus import exposition_response
+
+            status, resp_headers, body = exposition_response(
+                reg, refresh_runtime_gauges
             )
+            start_response(status, resp_headers)
             _counter(route, "2xx").inc()
             return [body]
 
         captured = {}
+        request_id = extract_request_id(environ)
+        set_request_id(request_id)
 
         def recording_start_response(status, headers, exc_info=None):
             captured["status"] = status
+            # echo the correlation ID so the client can quote it back;
+            # replace (don't duplicate) any header the inner app set
+            headers = [
+                (k, v) for k, v in headers if k.lower() != REQUEST_ID_HEADER.lower()
+            ] + [(REQUEST_ID_HEADER, request_id)]
             return start_response(status, headers, exc_info)
 
         try:
@@ -138,6 +151,8 @@ def instrument_wsgi(app, registry=None):
         except Exception:
             _counter(route, "5xx").inc()
             raise
+        finally:
+            clear_request_id()
         elapsed = time.perf_counter() - start
 
         status = captured.get("status", "500")

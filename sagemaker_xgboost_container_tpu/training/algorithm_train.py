@@ -30,7 +30,7 @@ from ..data.readers import (
     validate_data_file_path,
 )
 from ..parallel import distributed
-from ..telemetry import span
+from ..telemetry import register_runtime_gauges, span, start_cluster_telemetry
 from ..toolkit import exceptions as exc
 from ..toolkit.channels import PIPE_MODE
 from ..models import booster
@@ -85,6 +85,10 @@ def sagemaker_train(
     checkpoint_config,
 ):
     """Validate config, load data, select execution mode, run train_job."""
+    # XLA compile / RSS / device-buffer gauges: registered before any jax
+    # work so the first compile is counted (adds no threads; jax-absent and
+    # CPU-only paths no-op)
+    register_runtime_gauges()
     metrics = metrics_mod.initialize()
     hyperparameters = hpv.initialize(metrics)
     validated_train_config = hyperparameters.validate(train_config)
@@ -154,13 +158,21 @@ def sagemaker_train(
                 sm_current_host,
             )
             include_in_training = False
+        def _pre_exec(participating_hosts, current_host):
+            # order matters: jax.distributed first (it must precede any JAX
+            # computation), then the heartbeat plane over the RE-FORMED
+            # cluster — ranks must match the participating host list, not
+            # the original SM_HOSTS (hosts without data already exited)
+            maybe_init_jax_distributed(participating_hosts, current_host)
+            start_cluster_telemetry(participating_hosts, current_host)
+
         distributed.distributed_run(
             exec_fun=train_job,
             args=train_args,
             include_in_training=include_in_training,
             hosts=sm_hosts,
             current_host=sm_current_host,
-            pre_exec=maybe_init_jax_distributed,
+            pre_exec=_pre_exec,
         )
     elif num_hosts == 1:
         if train_dmatrix:

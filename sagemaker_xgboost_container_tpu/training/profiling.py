@@ -18,30 +18,23 @@ Three light-weight hooks:
 
 import contextlib
 import logging
-import math
 import os
 import time
 
-from ..telemetry import REGISTRY, emit_metric, pop_recorder, push_recorder
+from ..telemetry import (
+    REGISTRY,
+    ROUND_STATE,
+    emit_metric,
+    pop_recorder,
+    push_recorder,
+)
+from ..telemetry import percentile  # noqa: F401  (canonical home: telemetry.registry)
 
 logger = logging.getLogger(__name__)
 
 TRACE_DIR_ENV = "SM_PROFILER_TRACE_DIR"
 
 ROUND_HISTOGRAM = "training_round_seconds"
-
-
-def percentile(values, q):
-    """Exact linear-interpolation percentile of an unsorted list (q in 0..1)."""
-    if not values:
-        return float("nan")
-    ordered = sorted(values)
-    pos = (len(ordered) - 1) * q
-    lo = math.floor(pos)
-    hi = math.ceil(pos)
-    if lo == hi:
-        return ordered[lo]
-    return ordered[lo] + (ordered[hi] - ordered[lo]) * (pos - lo)
 
 
 class RoundTimer:
@@ -81,6 +74,9 @@ class RoundTimer:
             REGISTRY.histogram(
                 ROUND_HISTOGRAM, help="Boosting round wall time"
             ).observe(elapsed)
+            # feed the cluster heartbeat's round state (telemetry/cluster.py):
+            # a deque append under a lock — negligible, so always on
+            ROUND_STATE.note_round(epoch, elapsed)
             phases = self._recorder.drain() if self._recorder is not None else {}
             if self.emit_structured:
                 # callback work is measured by its spans; the remainder of the

@@ -2,19 +2,97 @@
 
 Serving/config knobs are tuning levers, not correctness inputs: a typo in
 one (``GRAFT_HOST_PREDICT_ROWS=off``) must degrade to the default, never
-turn into a per-request exception and a serving outage.
+turn into a per-request exception and a serving outage. Malformed values
+log exactly one warning per variable per process (warn-once) so a typo is
+visible in the job log without a reporter thread flooding it every
+interval.
+
+Range validation: out-of-range values clamp to the violated bound (an
+``SM_HEARTBEAT_TIMEOUT_S=-3`` means "the operator wanted a short timeout" —
+clamping to the 0.1s minimum honors the intent where a hard fallback to
+the default would not). Note the clamp bound is the caller's choice: for
+knobs where the minimum IS the disabled value (interval knobs with
+``minimum=0``), a negative value disables the feature — the warn-once
+makes that visible in the job log.
 """
 
+import logging
+import math
 import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+_TRUTHY = ("1", "true", "yes", "on")
+_FALSY = ("0", "false", "no", "off")
+
+_warn_lock = threading.Lock()
+_warned = set()
 
 
-def env_int(name, default):
+def _warn_once(name, raw, expected, used):
+    with _warn_lock:
+        if name in _warned:
+            return
+        _warned.add(name)
+    logger.warning(
+        "ignoring malformed %s=%r (expected %s); using %r", name, raw, expected, used
+    )
+
+
+def _clamp(name, raw, value, minimum, maximum):
+    if minimum is not None and value < minimum:
+        _warn_once(name, raw, ">= {}".format(minimum), minimum)
+        return minimum
+    if maximum is not None and value > maximum:
+        _warn_once(name, raw, "<= {}".format(maximum), maximum)
+        return maximum
+    return value
+
+
+def env_int(name, default, minimum=None, maximum=None):
     """int(os.environ[name]) with fallback to ``default`` on absent,
-    empty, or malformed values."""
+    empty, or malformed values; out-of-range values clamp (warn-once)."""
     raw = os.getenv(name)
     if raw is None or raw == "":
         return default
     try:
-        return int(raw)
+        value = int(raw)
     except ValueError:
+        _warn_once(name, raw, "an integer", default)
         return default
+    return _clamp(name, raw, value, minimum, maximum)
+
+
+def env_float(name, default, minimum=None, maximum=None):
+    """float(os.environ[name]) with fallback to ``default`` on absent,
+    empty, or malformed values; out-of-range values clamp (warn-once)."""
+    raw = os.getenv(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        _warn_once(name, raw, "a number", default)
+        return default
+    if not math.isfinite(value):  # NaN/inf: _clamp can't catch NaN, and an
+        # inf interval would arm a wait() that never fires — both malformed
+        _warn_once(name, raw, "a finite number", default)
+        return default
+    return _clamp(name, raw, value, minimum, maximum)
+
+
+def env_bool(name, default):
+    """Boolean env knob: 1/true/yes/on and 0/false/no/off (case-insensitive);
+    absent/empty -> ``default``; anything else -> ``default`` with a single
+    warning."""
+    raw = os.getenv(name)
+    if raw is None or raw == "":
+        return default
+    lowered = raw.strip().lower()
+    if lowered in _TRUTHY:
+        return True
+    if lowered in _FALSY:
+        return False
+    _warn_once(name, raw, "a boolean ({}/{})".format("|".join(_TRUTHY), "|".join(_FALSY)), default)
+    return default

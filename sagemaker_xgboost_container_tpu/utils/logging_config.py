@@ -25,11 +25,24 @@ def _resolve_level():
 
 
 def setup_main_logger(name):
-    """dictConfig console logger; returns the configured logger."""
+    """dictConfig console logger; returns the configured logger.
+
+    The console handler carries the request-correlation filter
+    (``telemetry.correlation.RequestIdFilter``): on serving request threads
+    every record gains the active request ID — both as ``record.request_id``
+    and as a ``[rid=...]`` suffix — so a slow invocation can be traced from
+    access log through batcher warnings to the response header.
+    """
     logging.config.dictConfig(
         {
             "version": 1,
             "disable_existing_loggers": False,
+            "filters": {
+                "request_id": {
+                    "()": "sagemaker_xgboost_container_tpu.telemetry"
+                    ".correlation.RequestIdFilter"
+                }
+            },
             "formatters": {
                 "standard": {
                     "format": "[%(asctime)s:%(levelname)s] %(message)s",
@@ -40,6 +53,7 @@ def setup_main_logger(name):
                 "console": {
                     "class": "logging.StreamHandler",
                     "formatter": "standard",
+                    "filters": ["request_id"],
                     "stream": "ext://sys.stdout",
                 }
             },

@@ -1,0 +1,537 @@
+"""Cluster telemetry plane tier-1 tests: heartbeat wire round-trip, rank-0
+aggregation under concurrent senders, straggler/stale episode detection, the
+dead-aggregator fire-and-forget path, device-runtime gauges on CPU, and the
+full 3-"host" simulated cluster through ``start_cluster_telemetry`` with the
+Prometheus endpoint (the acceptance scenario)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sagemaker_xgboost_container_tpu.parallel.distributed import (
+    frame_message,
+    recv_message,
+)
+from sagemaker_xgboost_container_tpu.telemetry import MetricsRegistry, render_text
+from sagemaker_xgboost_container_tpu.telemetry import cluster as cluster_mod
+from sagemaker_xgboost_container_tpu.telemetry.cluster import (
+    ClusterMetricsServer,
+    HeartbeatAggregator,
+    HeartbeatSender,
+    RoundState,
+    start_cluster_telemetry,
+)
+from tests.util_cluster import FakeHost, make_heartbeat, send_raw_heartbeat
+from tests.util_ports import free_port
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ----------------------------------------------------------------- wire format
+class TestWireFormat:
+    def test_frame_roundtrip_over_socketpair(self):
+        payload = make_heartbeat(rank=3, round_index=17, last_round_ms=123.4)
+        a, b = socket.socketpair()
+        try:
+            a.sendall(frame_message(payload))
+            assert recv_message(b) == payload
+        finally:
+            a.close()
+            b.close()
+
+    def test_frame_is_length_prefixed_json(self):
+        buf = frame_message({"type": "heartbeat", "rank": 0})
+        length = int.from_bytes(buf[:4], "little")
+        assert length == len(buf) - 4
+        assert json.loads(buf[4:].decode()) == {"type": "heartbeat", "rank": 0}
+
+    def test_sender_payload_carries_round_state_and_runtime(self):
+        state = RoundState()
+        for i in range(10):
+            state.note_round(i, 0.050)
+        state.note_round(10, 0.200)
+        sender = HeartbeatSender(
+            rank=2,
+            host="h2",
+            aggregator_addr=("127.0.0.1", 1),
+            interval=60,
+            timeout=0.2,
+            round_state=state,
+            registry=MetricsRegistry(),
+        )
+        payload = sender.build_payload()
+        assert payload["type"] == "heartbeat" and payload["rank"] == 2
+        assert payload["round"] == 10 and payload["rounds_total"] == 11
+        assert payload["last_round_ms"] == pytest.approx(200.0)
+        assert 50.0 <= payload["round_ms_p50"] <= 200.0
+        assert payload["round_ms_p95"] >= payload["round_ms_p50"]
+        assert payload["rss_bytes"] > 0
+        assert payload["threads"] >= 1
+        for key in ("device_bytes", "compile_count", "compile_seconds", "uptime_s"):
+            assert key in payload
+
+    def test_round_state_is_bounded(self):
+        state = RoundState(maxlen=8)
+        for i in range(1000):
+            state.note_round(i, 0.001 * (i + 1))
+        snap = state.snapshot()
+        assert snap["round"] == 999 and snap["rounds_total"] == 1000
+        assert len(state._times_ms) == 8
+        # quantiles reflect only the recent window
+        assert snap["round_ms_p50"] >= 0.9 * 996
+
+
+# ---------------------------------------------------------------- aggregation
+class TestAggregator:
+    def test_fold_in_under_concurrent_senders(self):
+        reg = MetricsRegistry()
+        agg = HeartbeatAggregator(
+            num_hosts=3, interval=60, port=0, registry=reg
+        ).start()
+        try:
+            per_rank = 5
+            threads = [
+                threading.Thread(
+                    target=lambda r=rank: [
+                        send_raw_heartbeat(
+                            agg.port,
+                            make_heartbeat(r, round_index=i, last_round_ms=100.0 + r),
+                        )
+                        for i in range(per_rank)
+                    ]
+                )
+                for rank in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(20)
+            assert _wait_for(
+                lambda: all(
+                    reg.counter(
+                        "cluster_heartbeats_received_total", labels={"rank": str(r)}
+                    ).value
+                    == per_rank
+                    for r in range(3)
+                )
+            ), render_text(reg)
+            for rank in range(3):
+                labels = {"rank": str(rank)}
+                assert (
+                    reg.gauge("cluster_last_round_ms", labels=labels).value
+                    == 100.0 + rank
+                )
+                assert reg.gauge("cluster_round", labels=labels).value == per_rank - 1
+                assert reg.gauge("cluster_rss_bytes", labels=labels).value > 0
+        finally:
+            agg.stop()
+
+    def test_malformed_and_unknown_rank_heartbeats_dropped(self, monkeypatch):
+        # tight frame deadline so the open trickle connection below costs the
+        # accept loop well under a second, not the 2s default
+        monkeypatch.setenv(cluster_mod.HEARTBEAT_TIMEOUT_ENV, "0.3")
+        reg = MetricsRegistry()
+        agg = HeartbeatAggregator(
+            num_hosts=2, interval=60, port=0, registry=reg
+        ).start()
+        trickle = None
+        try:
+            # raw garbage (bad frame), wrong type, unknown rank — none fold
+            sock = socket.create_connection(("127.0.0.1", agg.port), timeout=5)
+            sock.sendall(b"\xff\xff\x00\x00not json at all")
+            sock.close()
+            # oversized length prefix (an HTTP "GET " line is ~500MB as u32):
+            # rejected without blocking on the body
+            sock = socket.create_connection(("127.0.0.1", agg.port), timeout=5)
+            sock.sendall(b"GET /metrics HTTP/1.1\r\n")
+            sock.close()
+            # a trickling peer that never completes a frame: the total
+            # deadline must evict it so later heartbeats still fold
+            trickle = socket.create_connection(("127.0.0.1", agg.port), timeout=5)
+            trickle.sendall(b"\x08")
+            send_raw_heartbeat(agg.port, {"type": "not-a-heartbeat"})
+            send_raw_heartbeat(agg.port, make_heartbeat(rank=99))
+            send_raw_heartbeat(agg.port, make_heartbeat(rank=1))
+            assert _wait_for(
+                lambda: reg.counter(
+                    "cluster_heartbeats_received_total", labels={"rank": "1"}
+                ).value
+                == 1
+            )
+            text = render_text(reg)
+            assert 'rank="99"' not in text
+            assert 'rank="0"' not in text
+        finally:
+            if trickle is not None:
+                trickle.close()
+            agg.stop()
+
+    def test_straggler_episode_detection(self, capfd, caplog):
+        reg = MetricsRegistry()
+        agg = HeartbeatAggregator(
+            num_hosts=3, interval=60, port=0, registry=reg, factor=3.0, stale_after=100
+        )
+        # fold directly (no sockets): the detection logic is the unit here
+        agg.fold(make_heartbeat(0, last_round_ms=100.0))
+        agg.fold(make_heartbeat(1, last_round_ms=110.0))
+        agg.fold(make_heartbeat(2, last_round_ms=1000.0))
+        import logging
+
+        with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+            agg.evaluate()
+            agg.evaluate()  # same episode: must not warn/emit again
+        out = capfd.readouterr().out
+        stragglers = [
+            json.loads(l)
+            for l in out.splitlines()
+            if l.startswith('{"metric": "cluster.straggler"')
+        ]
+        assert len(stragglers) == 1, "one record per episode"
+        assert stragglers[0]["rank"] == 2
+        # median of the PEERS (100, 110), excluding the straggler itself
+        assert stragglers[0]["median_round_ms"] == pytest.approx(105.0)
+        assert stragglers[0]["round_ms"] == pytest.approx(1000.0)
+        warns = [r for r in caplog.records if "straggling" in r.message]
+        assert len(warns) == 1
+        assert (
+            reg.counter(
+                "cluster_straggler_episodes_total", labels={"rank": "2"}
+            ).value
+            == 1
+        )
+        # heartbeat summary records: one per evaluate tick
+        beats = [
+            l for l in out.splitlines() if l.startswith('{"metric": "cluster.heartbeat"')
+        ]
+        assert len(beats) == 2
+        # recovery ends the episode; a relapse starts a new one
+        agg.fold(make_heartbeat(2, last_round_ms=120.0))
+        agg.evaluate()
+        agg.fold(make_heartbeat(2, last_round_ms=2000.0))
+        agg.evaluate()
+        out = capfd.readouterr().out
+        assert any(
+            l.startswith('{"metric": "cluster.straggler"') for l in out.splitlines()
+        )
+        assert (
+            reg.counter(
+                "cluster_straggler_episodes_total", labels={"rank": "2"}
+            ).value
+            == 2
+        )
+
+    def test_two_host_straggler_detectable(self, capfd):
+        """n=2 regression: with an all-ranks median the trigger
+        b > factor*(a+b)/2 is unsatisfiable for factor >= 2 — peer-median
+        comparison must fire for a 2-host cluster."""
+        reg = MetricsRegistry()
+        agg = HeartbeatAggregator(
+            num_hosts=2, interval=60, port=0, registry=reg, factor=3.0, stale_after=100
+        )
+        agg.fold(make_heartbeat(0, last_round_ms=100.0))
+        agg.fold(make_heartbeat(1, last_round_ms=1000.0))
+        agg.evaluate()
+        out = capfd.readouterr().out
+        stragglers = [
+            json.loads(l)
+            for l in out.splitlines()
+            if l.startswith('{"metric": "cluster.straggler"')
+        ]
+        assert len(stragglers) == 1 and stragglers[0]["rank"] == 1
+        assert stragglers[0]["median_round_ms"] == pytest.approx(100.0)
+        # and the fast host must not be flagged against the slow peer
+        assert (
+            reg.counter(
+                "cluster_straggler_episodes_total", labels={"rank": "0"}
+            ).value
+            == 0
+        )
+
+    def test_restart_replaces_active_plane(self, monkeypatch):
+        """A second start_cluster_telemetry in one process stops the first
+        plane: the heartbeat port re-binds and no duplicate senders leak."""
+        port = free_port()
+        monkeypatch.setenv(cluster_mod.HEARTBEAT_INTERVAL_ENV, "30")
+        monkeypatch.setenv(cluster_mod.HEARTBEAT_PORT_ENV, str(port))
+        monkeypatch.delenv(cluster_mod.CLUSTER_METRICS_ENV, raising=False)
+        first = start_cluster_telemetry(["h0", "h1"], "h0")
+        try:
+            assert first is not None and first.aggregator is not None
+            second = start_cluster_telemetry(["h0", "h1"], "h0")
+            try:
+                assert second is not None and second.aggregator is not None
+                assert not first.sender._thread.is_alive()
+            finally:
+                second.stop()
+        finally:
+            first.stop()
+
+    def test_stale_host_detection_and_recovery(self, capfd, caplog):
+        import logging
+
+        reg = MetricsRegistry()
+        agg = HeartbeatAggregator(
+            num_hosts=2, interval=0.05, port=0, registry=reg, stale_after=2
+        )
+        agg.fold(make_heartbeat(0, last_round_ms=100.0))
+        agg.fold(make_heartbeat(1, last_round_ms=100.0))
+        time.sleep(0.25)  # > stale_after * interval
+        agg.fold(make_heartbeat(0, last_round_ms=100.0))  # rank 0 stays fresh
+        with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+            agg.evaluate()
+            agg.evaluate()
+        out = capfd.readouterr().out
+        stales = [
+            json.loads(l)
+            for l in out.splitlines()
+            if l.startswith('{"metric": "cluster.host_stale"')
+        ]
+        assert len(stales) == 1 and stales[0]["rank"] == 1
+        assert reg.counter("cluster_stale_episodes_total", labels={"rank": "1"}).value == 1
+        assert reg.gauge("cluster_reporting_hosts").value == 1
+        assert reg.gauge("cluster_heartbeat_age_seconds", labels={"rank": "1"}).value > 0.2
+        # heartbeats resume -> recovery logged, gauge recovers
+        agg.fold(make_heartbeat(1, last_round_ms=100.0))
+        with caplog.at_level(logging.INFO, "sagemaker_xgboost_container_tpu"):
+            agg.evaluate()
+        assert any("resumed" in r.message for r in caplog.records)
+        assert reg.gauge("cluster_reporting_hosts").value == 2
+
+
+# --------------------------------------------------------- dead aggregator
+class TestDeadAggregator:
+    def test_send_once_fire_and_forget(self, caplog):
+        import logging
+
+        reg = MetricsRegistry()
+        dead_port = free_port()  # nothing listening
+        sender = HeartbeatSender(
+            rank=1,
+            host="h1",
+            aggregator_addr=("127.0.0.1", dead_port),
+            interval=0.05,
+            timeout=0.5,
+            round_state=RoundState(),
+            registry=reg,
+        )
+        with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+            start = time.monotonic()
+            assert sender.send_once() is False
+            assert sender.send_once() is False
+            elapsed = time.monotonic() - start
+        # bounded: two refused connects must not take anywhere near 2 timeouts
+        assert elapsed < 5.0
+        labels = {"rank": "1"}
+        assert reg.counter("cluster_heartbeat_failures_total", labels=labels).value == 2
+        assert reg.counter("cluster_heartbeats_sent_total", labels=labels).value == 0
+        warns = [r for r in caplog.records if "heartbeat" in r.message.lower()]
+        assert len(warns) == 1, "one warning per outage episode"
+        # backoff grew beyond the configured interval
+        assert sender._delay > sender.interval
+
+    def test_sender_recovers_when_aggregator_appears(self, caplog):
+        import logging
+
+        reg = MetricsRegistry()
+        port = free_port()
+        sender = HeartbeatSender(
+            rank=0,
+            host="h0",
+            aggregator_addr=("127.0.0.1", port),
+            interval=0.05,
+            timeout=1.0,
+            round_state=RoundState(),
+            registry=reg,
+        )
+        assert sender.send_once() is False
+        agg_reg = MetricsRegistry()
+        agg = HeartbeatAggregator(
+            num_hosts=1, interval=60, port=port, registry=agg_reg
+        ).start()
+        try:
+            with caplog.at_level(logging.INFO, "sagemaker_xgboost_container_tpu"):
+                assert _wait_for(lambda: sender.send_once(), timeout=10)
+            assert sender._delay == sender.interval  # backoff reset
+            assert any("recovered" in r.message for r in caplog.records)
+        finally:
+            agg.stop()
+
+
+# ------------------------------------------------------ device-runtime gauges
+class TestRuntimeGauges:
+    def test_register_is_idempotent_and_cpu_safe(self):
+        # conftest pins JAX_PLATFORMS=cpu: registration must be a harmless
+        # no-op there (no crash, no thread)
+        before = threading.active_count()
+        cluster_mod.register_runtime_gauges()
+        cluster_mod.register_runtime_gauges()
+        assert threading.active_count() == before
+
+    def test_refresh_sets_process_gauges(self):
+        reg = MetricsRegistry()
+        snap = cluster_mod.refresh_runtime_gauges(reg)
+        assert reg.gauge("process_rss_bytes").value > 0
+        assert reg.gauge("process_threads").value >= 1
+        assert reg.gauge("process_open_fds").value > 0
+        assert reg.gauge("device_live_bytes").value >= 0
+        assert snap["rss_bytes"] == reg.gauge("process_rss_bytes").value
+
+    def test_compile_listener_counts_xla_compiles(self):
+        import jax
+        import jax.numpy as jnp
+
+        cluster_mod.register_runtime_gauges()
+        before = cluster_mod.compile_stats()["count"]
+
+        @jax.jit
+        def _fresh(x):
+            return x * 2 + 1
+
+        _fresh(jnp.arange(7.0)).block_until_ready()
+        after = cluster_mod.compile_stats()
+        assert after["count"] >= before  # CPU backends may or may not emit
+        assert after["seconds"] >= 0.0
+
+
+# ------------------------------------------------- full plane (acceptance sim)
+class TestClusterPlaneEndToEnd:
+    def test_inert_without_interval_env(self, monkeypatch):
+        monkeypatch.delenv(cluster_mod.HEARTBEAT_INTERVAL_ENV, raising=False)
+        before = threading.active_count()
+        assert start_cluster_telemetry(["a", "b"], "a") is None
+        assert threading.active_count() == before, "zero threads when unset"
+
+    def test_three_host_cluster_with_straggler_and_prometheus(
+        self, monkeypatch, capfd
+    ):
+        """The acceptance scenario: 3 simulated hosts, rank 0 runs the full
+        plane via start_cluster_telemetry (aggregator + metrics port +
+        loopback sender), ranks 1-2 are FakeHost senders, rank 2 reports
+        round latencies 10x the median. Rank 0 must expose per-rank
+        cluster_* gauges on the Prometheus endpoint and emit one
+        cluster.straggler record for rank 2."""
+        from sagemaker_xgboost_container_tpu import telemetry
+
+        hb_port = free_port()
+        metrics_port = free_port()
+        monkeypatch.setenv(cluster_mod.HEARTBEAT_INTERVAL_ENV, "0.1")
+        monkeypatch.setenv(cluster_mod.HEARTBEAT_PORT_ENV, str(hb_port))
+        monkeypatch.setenv(cluster_mod.CLUSTER_METRICS_ENV, str(metrics_port))
+        monkeypatch.setenv(cluster_mod.STRAGGLER_FACTOR_ENV, "3.0")
+        monkeypatch.setenv(cluster_mod.STALE_HEARTBEATS_ENV, "50")
+
+        # rank 0's own sender reads the module ROUND_STATE (RoundTimer's sink)
+        cluster_mod.ROUND_STATE.reset()
+        for i in range(5):
+            cluster_mod.ROUND_STATE.note_round(i, 0.100)
+
+        plane = start_cluster_telemetry(["host-0", "host-1", "host-2"], "host-0")
+        assert plane is not None and plane.rank == 0
+        assert plane.aggregator is not None and plane.metrics_server is not None
+        fakes = []
+        try:
+            fakes = [
+                FakeHost(1, hb_port, 0.1, round_ms=100.0, registry=MetricsRegistry()).start(),
+                FakeHost(2, hb_port, 0.1, round_ms=1000.0, registry=MetricsRegistry()).start(),
+            ]
+            reg = telemetry.REGISTRY
+            assert _wait_for(
+                lambda: all(
+                    reg.counter(
+                        "cluster_heartbeats_received_total", labels={"rank": str(r)}
+                    ).value
+                    >= 1
+                    for r in range(3)
+                ),
+                timeout=15,
+            ), "all three ranks must be folded in"
+            assert _wait_for(
+                lambda: reg.counter(
+                    "cluster_straggler_episodes_total", labels={"rank": "2"}
+                ).value
+                >= 1,
+                timeout=15,
+            ), "rank 2 must enter a straggler episode"
+
+            with urllib.request.urlopen(
+                "http://127.0.0.1:{}/metrics".format(metrics_port), timeout=10
+            ) as resp:
+                assert resp.status == 200
+                text = resp.read().decode("utf-8")
+            for rank in range(3):
+                assert 'cluster_round{rank="%d"}' % rank in text
+                assert 'cluster_last_round_ms{rank="%d"}' % rank in text
+                assert 'cluster_rss_bytes{rank="%d"}' % rank in text
+            assert "cluster_expected_hosts 3" in text
+            assert "process_rss_bytes" in text  # runtime gauges ride along
+        finally:
+            for fake in fakes:
+                fake.stop()
+            plane.stop()
+            cluster_mod.ROUND_STATE.reset()
+
+        out = capfd.readouterr().out
+        stragglers = [
+            json.loads(l)
+            for l in out.splitlines()
+            if l.startswith('{"metric": "cluster.straggler"')
+        ]
+        assert stragglers and all(s["rank"] == 2 for s in stragglers)
+        assert stragglers[0]["round_ms"] >= 3.0 * stragglers[0]["median_round_ms"]
+        beats = [
+            l for l in out.splitlines() if l.startswith('{"metric": "cluster.heartbeat"')
+        ]
+        assert beats, "one cluster.heartbeat record per interval"
+
+    def test_metrics_server_direct(self):
+        reg = MetricsRegistry()
+        reg.gauge("cluster_round", labels={"rank": "0"}).set(7)
+        srv = ClusterMetricsServer(0, registry=reg).start()
+        try:
+            with urllib.request.urlopen(
+                "http://127.0.0.1:{}/metrics".format(srv.port), timeout=10
+            ) as resp:
+                text = resp.read().decode()
+            assert 'cluster_round{rank="0"} 7' in text
+            # unknown path 404s
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:{}/other".format(srv.port), timeout=10
+                )
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()
+
+
+def test_round_timer_feeds_cluster_round_state():
+    """RoundTimer is the bridge: each round lands in ROUND_STATE so the
+    heartbeat payload carries live round/latency data."""
+    from sagemaker_xgboost_container_tpu.training.profiling import RoundTimer
+
+    cluster_mod.ROUND_STATE.reset()
+    try:
+        timer = RoundTimer(log_every=0, emit_structured=False)
+        timer.before_training(None)
+        for epoch in range(3):
+            timer.after_iteration(None, epoch, {})
+        timer.after_training(None)
+        snap = cluster_mod.ROUND_STATE.snapshot()
+        assert snap["round"] == 2
+        assert snap["rounds_total"] == 3
+        assert snap["round_ms_p50"] >= 0.0
+    finally:
+        cluster_mod.ROUND_STATE.reset()

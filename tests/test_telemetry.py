@@ -10,6 +10,7 @@ import re
 import subprocess
 import sys
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -562,6 +563,265 @@ def test_logging_level_env(monkeypatch):
     monkeypatch.delenv("SAGEMAKER_CONTAINER_LOG_LEVEL")
     setup_main_logger("t")
     assert logging.getLogger().level == logging.INFO
+
+
+class TestQuantileConsolidation:
+    """One exact-percentile implementation (telemetry.registry.percentile);
+    the histogram estimator must agree with it to bucket resolution."""
+
+    def test_profiling_reexports_registry_percentile(self):
+        from sagemaker_xgboost_container_tpu.telemetry import (
+            percentile as registry_percentile,
+        )
+
+        assert percentile is registry_percentile
+
+    def test_percentile_rejects_out_of_range_q(self):
+        with pytest.raises(ValueError):
+            percentile([1.0, 2.0], 1.5)
+
+    def test_histogram_estimate_tracks_exact_on_random_samples(self):
+        import bisect
+
+        from sagemaker_xgboost_container_tpu.telemetry import DEFAULT_BUCKETS
+
+        rng = np.random.RandomState(7)
+        for trial in range(5):
+            samples = rng.uniform(0.0005, 9.0, size=400)
+            h = MetricsRegistry().histogram(
+                "q_seconds", buckets=DEFAULT_BUCKETS
+            )
+            for s in samples:
+                h.observe(s)
+            for q in (0.05, 0.25, 0.5, 0.75, 0.9, 0.99):
+                exact = percentile(list(samples), q)
+                est = h.quantile(q)
+                # same or adjacent bucket: the estimator can never drift
+                # further than bucket resolution from the exact statistic
+                idx_exact = bisect.bisect_left(h.bounds, exact)
+                idx_est = bisect.bisect_left(h.bounds, est)
+                assert abs(idx_est - idx_exact) <= 1, (
+                    trial,
+                    q,
+                    exact,
+                    est,
+                )
+
+
+class TestEnvConfig:
+    def test_env_float_parses_and_defaults(self, monkeypatch):
+        from sagemaker_xgboost_container_tpu.utils.envconfig import env_float
+
+        monkeypatch.setenv("T_ENVF_OK", "2.5")
+        assert env_float("T_ENVF_OK", 1.0) == 2.5
+        monkeypatch.delenv("T_ENVF_ABSENT", raising=False)
+        assert env_float("T_ENVF_ABSENT", 1.25) == 1.25
+        monkeypatch.setenv("T_ENVF_EMPTY", "")
+        assert env_float("T_ENVF_EMPTY", 0.5) == 0.5
+
+    def test_env_float_malformed_warns_once(self, monkeypatch, caplog):
+        from sagemaker_xgboost_container_tpu.utils.envconfig import env_float
+
+        monkeypatch.setenv("T_ENVF_BAD", "not-a-number")
+        with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+            assert env_float("T_ENVF_BAD", 3.0) == 3.0
+            assert env_float("T_ENVF_BAD", 3.0) == 3.0
+            assert env_float("T_ENVF_BAD", 3.0) == 3.0
+        warns = [r for r in caplog.records if "T_ENVF_BAD" in r.message]
+        assert len(warns) == 1, "malformed values warn exactly once"
+
+    def test_env_float_range_clamps(self, monkeypatch, caplog):
+        from sagemaker_xgboost_container_tpu.utils.envconfig import env_float
+
+        monkeypatch.setenv("T_ENVF_NEG", "-4")
+        with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+            assert env_float("T_ENVF_NEG", 1.0, minimum=0.1) == 0.1
+        monkeypatch.setenv("T_ENVF_BIG", "9999")
+        assert env_float("T_ENVF_BIG", 1.0, maximum=30.0) == 30.0
+        monkeypatch.setenv("T_ENVF_NAN", "nan")
+        assert env_float("T_ENVF_NAN", 2.0, minimum=0.0) == 2.0
+        # inf would arm an Event.wait() that never fires: malformed, not valid
+        monkeypatch.setenv("T_ENVF_INF", "inf")
+        assert env_float("T_ENVF_INF", 2.0) == 2.0
+
+    def test_env_int_and_bool(self, monkeypatch, caplog):
+        from sagemaker_xgboost_container_tpu.utils.envconfig import (
+            env_bool,
+            env_int,
+        )
+
+        monkeypatch.setenv("T_ENVI_OK", "42")
+        assert env_int("T_ENVI_OK", 0) == 42
+        monkeypatch.setenv("T_ENVI_BAD", "4.5")
+        assert env_int("T_ENVI_BAD", 7) == 7
+        monkeypatch.setenv("T_ENVI_RANGE", "70000")
+        assert env_int("T_ENVI_RANGE", 1, maximum=65535) == 65535
+
+        for raw, expected in (
+            ("true", True), ("1", True), ("YES", True), ("on", True),
+            ("false", False), ("0", False), ("No", False), ("OFF", False),
+        ):
+            monkeypatch.setenv("T_ENVB", raw)
+            assert env_bool("T_ENVB", not expected) is expected
+        monkeypatch.delenv("T_ENVB")
+        assert env_bool("T_ENVB", True) is True
+        monkeypatch.setenv("T_ENVB_BAD", "maybe")
+        with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+            assert env_bool("T_ENVB_BAD", False) is False
+            assert env_bool("T_ENVB_BAD", True) is True
+        warns = [r for r in caplog.records if "T_ENVB_BAD" in r.message]
+        assert len(warns) == 1
+
+    def test_serving_knobs_ride_envconfig(self, monkeypatch):
+        """The migrated call sites: metrics endpoint gate and structured
+        emission accept the full boolean vocabulary now."""
+        monkeypatch.setenv(telemetry.METRICS_ENDPOINT_ENV, "yes")
+        assert telemetry.metrics_endpoint_enabled() is True
+        monkeypatch.setenv(telemetry.STRUCTURED_METRICS_ENV, "no")
+        assert telemetry.structured_enabled() is False
+
+
+class TestMetricsReporterLifecycle:
+    def test_reporter_returns_stop_handle_and_stops(self, capfd):
+        from sagemaker_xgboost_container_tpu.serving.server import (
+            start_metrics_reporter,
+        )
+
+        reg = MetricsRegistry()
+        reg.counter("reporter_test_total").inc(3)
+        reporter = start_metrics_reporter(interval=0.05, registry=reg)
+        assert reporter is not None
+        deadline = time.monotonic() + 10
+        seen = ""
+        while time.monotonic() < deadline:
+            seen += capfd.readouterr().out
+            if '"metric": "serving.snapshot"' in seen:
+                break
+            time.sleep(0.02)
+        assert '"metric": "serving.snapshot"' in seen
+        reporter.stop(timeout=5.0)
+        assert not reporter._thread.is_alive(), "stop() must kill the loop"
+        capfd.readouterr()
+        time.sleep(0.15)
+        assert '"serving.snapshot"' not in capfd.readouterr().out
+
+    def test_reporter_disabled_paths(self, monkeypatch):
+        from sagemaker_xgboost_container_tpu.serving import server
+
+        monkeypatch.delenv(server.METRICS_INTERVAL_ENV, raising=False)
+        assert server.start_metrics_reporter() is None
+        monkeypatch.setenv(server.METRICS_INTERVAL_ENV, "bogus")
+        assert server.start_metrics_reporter() is None
+        monkeypatch.setenv(server.METRICS_INTERVAL_ENV, "0")
+        assert server.start_metrics_reporter() is None
+
+
+class TestRequestCorrelation:
+    def test_extract_honors_x_request_id(self):
+        from sagemaker_xgboost_container_tpu.telemetry.correlation import (
+            extract_request_id,
+        )
+
+        assert extract_request_id({"HTTP_X_REQUEST_ID": "abc-123"}) == "abc-123"
+        # hostile values are sanitized, length-bounded
+        rid = extract_request_id({"HTTP_X_REQUEST_ID": "a b\nc" + "x" * 200})
+        assert "\n" not in rid and " " not in rid and len(rid) <= 64
+
+    def test_extract_honors_custom_attributes(self):
+        from sagemaker_xgboost_container_tpu.telemetry.correlation import (
+            extract_request_id,
+        )
+
+        env = {
+            "HTTP_X_AMZN_SAGEMAKER_CUSTOM_ATTRIBUTES": "c=1,trace_id=t-99,d=2"
+        }
+        assert extract_request_id(env) == "t-99"
+        env = {"HTTP_X_AMZN_SAGEMAKER_CUSTOM_ATTRIBUTES": "request_id=r-7"}
+        assert extract_request_id(env) == "r-7"
+        # no recognized key -> generated, non-empty, unique
+        a = extract_request_id({"HTTP_X_AMZN_SAGEMAKER_CUSTOM_ATTRIBUTES": "x=y"})
+        b = extract_request_id({})
+        assert a and b and a != b
+
+    def test_middleware_echoes_request_id_header(self):
+        from sagemaker_xgboost_container_tpu.telemetry import instrument_wsgi
+
+        def tiny_app(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"ok"]
+
+        base, httpd = _serve(instrument_wsgi(tiny_app))
+        try:
+            _, _, headers = _request(
+                base + "/ping", headers={"X-Request-Id": "my-rid-1"}
+            )
+            assert headers["X-Request-Id"] == "my-rid-1"
+            _, _, headers = _request(base + "/ping")
+            assert headers["X-Request-Id"]  # generated when absent
+            _, _, headers = _request(
+                base + "/ping",
+                headers={"X-Amzn-SageMaker-Custom-Attributes": "trace_id=t-5"},
+            )
+            assert headers["X-Request-Id"] == "t-5"
+        finally:
+            httpd.shutdown()
+
+    def test_logging_filter_tags_records(self):
+        from sagemaker_xgboost_container_tpu.telemetry.correlation import (
+            RequestIdFilter,
+            clear_request_id,
+            set_request_id,
+        )
+
+        f = RequestIdFilter()
+        set_request_id("rid-42")
+        try:
+            record = logging.LogRecord(
+                "t", logging.INFO, __file__, 1, "hello %s", ("world",), None
+            )
+            f.filter(record)
+            assert record.request_id == "rid-42"
+            assert record.getMessage() == "hello world [rid=rid-42]"
+            f.filter(record)  # multiple handlers: no double tag
+            assert record.getMessage().count("[rid=") == 1
+        finally:
+            clear_request_id()
+        record = logging.LogRecord("t", logging.INFO, __file__, 1, "plain", (), None)
+        f.filter(record)
+        assert record.request_id == "-"
+        assert record.getMessage() == "plain"
+
+    def test_batcher_timeout_warning_names_request(self, caplog):
+        from sagemaker_xgboost_container_tpu.telemetry.correlation import (
+            clear_request_id,
+            set_request_id,
+        )
+
+        reg = MetricsRegistry()
+        release = threading.Event()
+
+        def stuck(feats):
+            release.wait(10)
+            return np.zeros(feats.shape[0], np.float32)
+
+        b = PredictBatcher(stuck, max_wait_ms=0.1, name="rid", registry=reg)
+        x = np.zeros((1, 2), np.float32)
+        blocker = threading.Thread(target=lambda: _swallow_predict(b, x))
+        blocker.start()
+        import time as _time
+
+        _time.sleep(0.25)
+        set_request_id("rid-trace-me")
+        try:
+            with caplog.at_level(logging.WARNING, "sagemaker_xgboost_container_tpu"):
+                with pytest.raises(TimeoutError):
+                    b.predict(x, timeout=0.2)
+        finally:
+            clear_request_id()
+            release.set()
+            blocker.join(15)
+        warns = [r for r in caplog.records if "timed out" in r.message]
+        assert warns and "rid-trace-me" in warns[0].getMessage()
 
 
 def test_no_print_static_check():

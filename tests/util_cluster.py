@@ -1,6 +1,16 @@
-"""Spawn-safe helpers for multi-process cluster tests."""
+"""Spawn-safe helpers for multi-process cluster tests + cluster-plane fakes."""
 
-from sagemaker_xgboost_container_tpu.parallel.distributed import Cluster
+import socket
+
+from sagemaker_xgboost_container_tpu.parallel.distributed import (
+    Cluster,
+    frame_message,
+)
+from sagemaker_xgboost_container_tpu.telemetry.cluster import (
+    HEARTBEAT_VERSION,
+    HeartbeatSender,
+    RoundState,
+)
 
 HOSTS = ["127.0.0.1", "localhost"]
 
@@ -11,3 +21,67 @@ def sync_worker(host, q, port):
         {"host": host, "include_in_training": host != "localhost"}
     )
     q.put((host, out))
+
+
+def make_heartbeat(rank, host=None, round_index=0, last_round_ms=100.0, **extra):
+    """A syntactically-valid heartbeat payload with controllable latency —
+    the unit under test is the aggregator, so payloads are hand-built."""
+    payload = {
+        "type": "heartbeat",
+        "v": HEARTBEAT_VERSION,
+        "rank": rank,
+        "host": host or "fake-host-{}".format(rank),
+        "round": round_index,
+        "rounds_total": round_index + 1,
+        "last_round_ms": last_round_ms,
+        "round_ms_p50": last_round_ms,
+        "round_ms_p95": last_round_ms * 1.1,
+        "rss_bytes": 1024 * 1024 * (rank + 1),
+        "device_bytes": 2048 * (rank + 1),
+        "open_fds": 10 + rank,
+        "threads": 5,
+        "compile_count": 1,
+        "compile_seconds": 0.5,
+        "uptime_s": 42.0,
+    }
+    payload.update(extra)
+    return payload
+
+
+def send_raw_heartbeat(port, payload, host="127.0.0.1", timeout=5.0):
+    """Deliver one framed payload to an aggregator, bypassing HeartbeatSender
+    (lets tests send arbitrary — including malformed — frames)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        sock.sendall(frame_message(payload))
+    finally:
+        sock.close()
+
+
+class FakeHost:
+    """One simulated cluster member: a HeartbeatSender with an injected
+    RoundState so each 'host' in a single test process reports its own
+    (controllable) round latencies."""
+
+    def __init__(
+        self, rank, port, interval, round_ms=100.0, rounds=5, timeout=1.0, registry=None
+    ):
+        self.round_state = RoundState()
+        for i in range(rounds):
+            self.round_state.note_round(i, round_ms / 1000.0)
+        self.sender = HeartbeatSender(
+            rank=rank,
+            host="fake-host-{}".format(rank),
+            aggregator_addr=("127.0.0.1", port),
+            interval=interval,
+            timeout=timeout,
+            round_state=self.round_state,
+            registry=registry,
+        )
+
+    def start(self):
+        self.sender.start()
+        return self
+
+    def stop(self):
+        self.sender.stop(timeout=5.0)

@@ -139,3 +139,12 @@ SAGEMAKER_DEFAULT_INVOCATIONS_ACCEPT = "SAGEMAKER_DEFAULT_INVOCATIONS_ACCEPT"
 SAGEMAKER_BATCH = "SAGEMAKER_BATCH"
 
 ONE_THREAD_PER_PROCESS = "1"
+
+# ---------------------------------------------------------------------------
+# Supervision exit codes (docs/robustness.md carries the full table). Distinct
+# non-zero codes so the platform restarts the job AND the job log pinpoints
+# which supervisor pulled the trigger. Chosen above the shell/signal ranges
+# (1, 2, 126-128, 128+N) so they never collide with an organic failure.
+# ---------------------------------------------------------------------------
+EXIT_ROUND_DEADLINE = 79  # round watchdog: a boosting round exceeded its deadline
+EXIT_CLUSTER_ABORT = 80   # coordinated abort: rank 0 declared a peer dead

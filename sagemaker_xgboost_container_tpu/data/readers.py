@@ -26,11 +26,28 @@ import numpy as np
 import scipy.sparse as sp
 
 from ..toolkit import exceptions as exc
+from ..utils.faults import fault_point
+from ..utils.retry import retry_transient
 from . import content_types as ct
 from .matrix import DataMatrix
 from .recordio import read_recordio_protobuf
 
 logger = logging.getLogger(__name__)
+
+
+def _read_with_retries(fn, path, site):
+    """Per-file read under the transient-retry policy (utils/retry.py).
+
+    Retries bound OSError only — Fast File mode surfaces S3 blips as plain
+    IO errors — while parse/semantic failures (UserError territory)
+    propagate on the first attempt. ``data.read`` is the ingest fault point.
+    """
+
+    def _attempt():
+        fault_point("data.read", path=path)
+        return fn()
+
+    return retry_transient(_attempt, site=site)
 
 MAX_FOLDER_DEPTH = 3
 STAGING_DIR = "/tmp/sagemaker_xgboost_tpu_input_data"
@@ -240,10 +257,21 @@ def _read_csv_files(path, csv_weights=0):
     files = _list_data_files(path)
     if not files:
         return None
-    with open(files[0], "r", errors="ignore") as f:
-        delimiter = _sniff_csv_delimiter(f.readline())
+
+    def _first_line(p):
+        with open(p, "r", errors="ignore") as f:
+            return f.readline()
+
+    delimiter = _sniff_csv_delimiter(
+        _read_with_retries(lambda: _first_line(files[0]), files[0], "reader.csv")
+    )
     frames = [
-        pd.read_csv(f, header=None, delimiter=delimiter, dtype=np.float32) for f in files
+        _read_with_retries(
+            lambda f=f: pd.read_csv(f, header=None, delimiter=delimiter, dtype=np.float32),
+            f,
+            "reader.csv",
+        )
+        for f in files
     ]
     data = pd.concat(frames, axis=0, ignore_index=True).to_numpy(dtype=np.float32)
     if data.shape[1] < 2:
@@ -360,9 +388,13 @@ def _read_libsvm_files(path):
     parts = []
     sidecar_groups = []
     sidecar_weights = []
+    def _read_text(path):
+        with open(path, "r", errors="ignore") as fh:
+            return fh.read()
+
     for f in files:
-        with open(f, "r", errors="ignore") as fh:
-            parsed = parse_libsvm_text(fh.read())
+        text = _read_with_retries(lambda f=f: _read_text(f), f, "reader.libsvm")
+        parsed = parse_libsvm_text(text)
         if parsed is not None:
             parts.append(parsed)
             gf = _companion_file(f, (".group",))
@@ -400,7 +432,10 @@ def _read_parquet_files(path):
     files = _list_data_files(path)
     if not files:
         return None
-    tables = [pq.read_table(f) for f in files]
+    tables = [
+        _read_with_retries(lambda f=f: pq.read_table(f), f, "reader.parquet")
+        for f in files
+    ]
     arrays = [t.to_pandas().to_numpy(dtype=np.float32) for t in tables]
     data = np.concatenate(arrays, axis=0) if len(arrays) > 1 else arrays[0]
     return DataMatrix(data[:, 1:], labels=data[:, 0])
@@ -410,10 +445,14 @@ def _read_recordio_files(path):
     files = _list_data_files(path)
     if not files:
         return None
-    bufs = []
-    for f in files:
-        with open(f, "rb") as fh:
-            bufs.append(fh.read())
+    def _read_bytes(path):
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    bufs = [
+        _read_with_retries(lambda f=f: _read_bytes(f), f, "reader.recordio")
+        for f in files
+    ]
     features, labels = read_recordio_protobuf(b"".join(bufs))
     return DataMatrix(features, labels=labels)
 

@@ -46,8 +46,12 @@ from .forest import Forest, compact_padded_tree
 
 try:
     from jax import shard_map
+
+    _SHARD_MAP_REP_KW = {"check_vma": False}
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
+
+    _SHARD_MAP_REP_KW = {"check_rep": False}  # pre-0.6 kwarg name
 
 logger = logging.getLogger(__name__)
 
@@ -873,7 +877,7 @@ class _TrainingSession:
             mesh=self.mesh,
             in_specs=in_specs,
             out_specs=out_specs,
-            check_vma=False,
+            **_SHARD_MAP_REP_KW,
         )
         return jax.jit(mapped, donate_argnums=donate)
 
@@ -897,7 +901,7 @@ class _TrainingSession:
             mesh=self.mesh,
             in_specs=(P(), P("data", None), margin_spec),
             out_specs=margin_spec,
-            check_vma=False,
+            **_SHARD_MAP_REP_KW,
         )
         return jax.jit(mapped, donate_argnums=(2,))
 

@@ -25,13 +25,31 @@ import json
 import logging
 import socket
 import struct
+import threading
 import time
 
 from ..toolkit import exceptions as exc
+from ..utils.envconfig import env_float, env_int
+from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 9099
+
+SYNC_RECV_TIMEOUT_ENV = "SM_SYNC_RECV_TIMEOUT_S"
+
+ABORT_PORT_ENV = "SM_ABORT_PORT"
+# NOT the rendezvous (9099) or heartbeat (9199) ports: the abort channel
+# must stay reachable while both of those are mid-conversation
+DEFAULT_ABORT_PORT = 9299
+
+# an abort/rendezvous frame is small JSON; a stray HTTP client's request
+# line parses as a ~500MB u32 length — reject before allocating on it
+MAX_CONTROL_FRAME_BYTES = 1 << 20
+
+
+def sync_recv_timeout():
+    return env_float(SYNC_RECV_TIMEOUT_ENV, 30.0, minimum=0.1, maximum=600.0)
 
 
 def wait_hostname_resolution(sm_hosts, max_wait_seconds=900):
@@ -85,6 +103,37 @@ def recv_message(sock):
     return json.loads(recv_exact(sock, length).decode())
 
 
+def recv_message_bounded(sock, timeout, max_bytes=MAX_CONTROL_FRAME_BYTES):
+    """Read one framed message under a TOTAL deadline.
+
+    ``recv_message``'s per-recv timeout resets on every chunk, so a peer
+    trickling one byte per timeout window can hold the reader indefinitely
+    — exactly the wedge this variant exists to bound. Also sanity-caps the
+    length prefix so a stray client can't make us block on (or allocate) a
+    garbage frame. Shared by the rendezvous collect loop, the heartbeat
+    aggregator, and the abort listener.
+    """
+    deadline = time.monotonic() + timeout
+
+    def _read(n):
+        buf = b""
+        while len(buf) < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("frame read deadline exceeded")
+            sock.settimeout(remaining)
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    (length,) = struct.unpack("<I", _read(4))
+    if max_bytes is not None and length > max_bytes:
+        raise ValueError("oversized control frame ({} bytes)".format(length))
+    return json.loads(_read(length).decode())
+
+
 # historical private names, kept for in-repo callers
 _recv_exact = recv_exact
 _send_msg = send_message
@@ -109,46 +158,108 @@ class Cluster:
     def num_hosts(self):
         return len(self.hosts)
 
-    def synchronize(self, payload, timeout=300):
+    def _missing_ranks_error(self, results, timeout):
+        missing = sorted(set(range(self.num_hosts)) - set(results))
+        return exc.PlatformError(
+            "Cluster rendezvous timed out after {}s: missing rank(s) {} "
+            "(hosts {}). Those hosts are down, unreachable on port {}, or "
+            "sending too slowly.".format(
+                timeout,
+                missing,
+                [self.hosts[r] for r in missing],
+                self.port,
+            )
+        )
+
+    def synchronize(self, payload, timeout=300, recv_timeout=None):
         """Allgather small JSON payloads across hosts -> list in rank order.
 
         Master accepts one connection per worker, collects payloads, sends
         the full rank-ordered list back (the reference's synchronize,
         distributed.py:125-138). Single-host clusters short-circuit.
+
+        Every blocking step is deadlined: ``timeout`` bounds the whole
+        collect loop (accept used to be the only deadlined call — a worker
+        that connected and then stalled or trickled bytes hung the master
+        forever), and each connection's recv runs under ``recv_timeout``
+        (``SM_SYNC_RECV_TIMEOUT_S``, default 30s) via the total-deadline
+        frame reader. On expiry the master raises ``PlatformError`` naming
+        the missing ranks/hosts.
         """
         if self.num_hosts == 1:
             return [payload]
+        if recv_timeout is None:
+            recv_timeout = sync_recv_timeout()
         if self.is_master:
             results = {0: payload}
             server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             server.bind(("0.0.0.0", self.port))
             server.listen(self.num_hosts)
-            server.settimeout(timeout)
+            deadline = time.monotonic() + timeout
             conns = []
             try:
                 while len(results) < self.num_hosts:
-                    conn, _ = server.accept()
-                    msg = _recv_msg(conn)
-                    results[int(msg["rank"])] = msg["payload"]
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise self._missing_ranks_error(results, timeout)
+                    server.settimeout(remaining)
+                    try:
+                        conn, addr = server.accept()
+                    except socket.timeout:
+                        raise self._missing_ranks_error(results, timeout)
+                    fault_point("sync.accept", addr=addr)
+                    try:
+                        msg = recv_message_bounded(
+                            conn, min(recv_timeout, remaining)
+                        )
+                        rank = int(msg["rank"])
+                        if not 0 <= rank < self.num_hosts or rank in results:
+                            raise ValueError(
+                                "invalid or duplicate rank {}".format(rank)
+                            )
+                        payload_value = msg["payload"]
+                    except (OSError, ValueError, KeyError, TypeError) as e:
+                        # a wedged/trickling/garbage peer (stray client,
+                        # out-of-range or already-claimed rank): drop the
+                        # conn and keep collecting — a *real* rank stays
+                        # missing and the overall deadline names it
+                        logger.warning(
+                            "rendezvous: dropping connection from %s (%s); "
+                            "its rank remains outstanding", addr, e
+                        )
+                        conn.close()
+                        continue
+                    results[rank] = payload_value
                     conns.append(conn)
                 ordered = [results[r] for r in range(self.num_hosts)]
                 for conn in conns:
-                    _send_msg(conn, ordered)
-                    conn.close()
+                    try:
+                        # recv_message_bounded left the conn at whatever
+                        # sliver of its frame deadline remained; give the
+                        # reply its own full send budget
+                        conn.settimeout(recv_timeout)
+                        _send_msg(conn, ordered)
+                    except OSError as e:
+                        # the worker will retry its own connect loop; the
+                        # allgather result is already complete for the rest
+                        logger.warning("rendezvous: reply send failed: %s", e)
+                    finally:
+                        conn.close()
             finally:
                 server.close()
             return ordered
         # worker: connect with retry (master may be slow to bind)
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         last_err = None
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             try:
                 sock = socket.create_connection((self.master_host, self.port), timeout=10)
                 try:
                     _send_msg(sock, {"rank": self.rank, "payload": payload})
-                    sock.settimeout(timeout)
-                    return _recv_msg(sock)
+                    return recv_message_bounded(
+                        sock, max(deadline - time.monotonic(), 0.1)
+                    )
                 finally:
                     sock.close()
             except (ConnectionError, OSError) as e:
@@ -158,6 +269,109 @@ class Cluster:
             "Could not synchronize with master {}".format(self.master_host),
             caused_by=last_err,
         )
+
+
+# --------------------------------------------------------------- abort plane
+def abort_port():
+    return env_int(ABORT_PORT_ENV, DEFAULT_ABORT_PORT, minimum=1, maximum=65535)
+
+
+class AbortListener:
+    """Per-host abort endpoint: accept one framed ``{"type": "abort"}`` JSON
+    message and hand it to ``handler``.
+
+    The listener exists because a dead peer stalls every survivor *inside*
+    a jitted collective — no in-band channel can reach them. Rank 0's
+    stale-host detector (telemetry/cluster.py) broadcasts an abort frame
+    here so every rank exits cleanly (checkpoint flushed, distinct exit
+    code) instead of deadlocking. Daemon thread, bounded accept timeout,
+    junk frames dropped; the handler is responsible for the actual abort
+    (training/watchdog.request_abort).
+    """
+
+    def __init__(self, handler, port=None, frame_timeout=5.0):
+        self.handler = handler
+        self.frame_timeout = frame_timeout
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", abort_port() if port is None else port))
+        self._server.listen(8)
+        self._server.settimeout(0.2)
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="abort-listener"
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        self._thread.join(timeout)
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # socket closed under us
+            try:
+                msg = recv_message_bounded(conn, self.frame_timeout)
+            except Exception as e:
+                logger.debug("abort listener: dropping malformed frame: %s", e)
+                continue
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            if isinstance(msg, dict) and msg.get("type") == "abort":
+                logger.error(
+                    "abort frame received from %s (reason: %s)",
+                    msg.get("source", addr[0]),
+                    msg.get("reason", "unspecified"),
+                )
+                try:
+                    self.handler(msg)
+                except Exception:
+                    logger.exception("abort handler failed")
+            else:
+                logger.warning("abort listener: ignoring non-abort frame from %s", addr)
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+def broadcast_abort(hosts, reason, source=None, port=None, timeout=2.0):
+    """Best-effort abort fan-out: one framed message per host, bounded
+    connect/send timeouts, failures logged not raised (a host that's
+    already dead is exactly why we're broadcasting). Returns the number of
+    hosts the frame was delivered to."""
+    target_port = abort_port() if port is None else port
+    frame = {"type": "abort", "reason": reason, "source": source}
+    delivered = 0
+    for host in hosts:
+        fault_point("abort.broadcast", host=host)
+        try:
+            sock = socket.create_connection((host, target_port), timeout=timeout)
+            try:
+                sock.settimeout(timeout)
+                sock.sendall(frame_message(frame))
+                delivered += 1
+            finally:
+                sock.close()
+        except OSError as e:
+            logger.warning("abort broadcast to %s:%d failed: %s", host, target_port, e)
+    return delivered
 
 
 def distributed_run(

@@ -52,6 +52,9 @@ class ScoringService:
         self.model_format = None
         self._batcher = None
         self._load_lock = threading.Lock()
+        from .breaker import CircuitBreaker
+
+        self.breaker = CircuitBreaker(name="single")
 
     def load_model(self):
         # lock: concurrent first requests on the threaded server must not
@@ -64,12 +67,20 @@ class ScoringService:
                 if not isinstance(self.model, list) and os.getenv(
                     "SAGEMAKER_SERVING_BATCHING", "true"
                 ).lower() == "true":
+                    from ..utils.envconfig import env_int
                     from .batcher import PredictBatcher
 
                     model = self.model
                     rng = serve_utils.best_iteration_range(model)
+                    # bounded queue (the MME/MMS knob, same default): an
+                    # unbounded queue under saturation just converts
+                    # overload into 60s client timeouts — JobQueueFull is
+                    # what lets the circuit breaker shed load instead
                     self._batcher = PredictBatcher(
-                        lambda feats: model.predict(feats, iteration_range=rng)
+                        lambda feats: model.predict(feats, iteration_range=rng),
+                        max_queue=env_int(
+                            "SAGEMAKER_MODEL_JOB_QUEUE_SIZE", 100, minimum=1
+                        ),
                     )
                 # compile the first device buckets off the request path
                 serve_utils.warmup_predict_async(self.model)
@@ -99,14 +110,27 @@ class ScoringService:
         )
 
 
-def _response(start_response, status, body=b"", content_type="text/plain"):
+def _response(start_response, status, body=b"", content_type="text/plain", extra_headers=None):
     if isinstance(body, str):
         body = body.encode("utf-8")
+    headers = [("Content-Type", content_type), ("Content-Length", str(len(body)))]
+    if extra_headers:
+        headers.extend(extra_headers)
     start_response(
         "{} {}".format(status, http.client.responses.get(status, "")),
-        [("Content-Type", content_type), ("Content-Length", str(len(body)))],
+        headers,
     )
     return [body]
+
+
+def _shed_response(start_response, breaker, detail):
+    """503 + Retry-After: the load-shedding contract (docs/robustness.md)."""
+    return _response(
+        start_response,
+        http.client.SERVICE_UNAVAILABLE,
+        "Temporarily overloaded: {}. Retry after the indicated delay.".format(detail),
+        extra_headers=[("Retry-After", str(breaker.retry_after_s()))],
+    )
 
 
 def parse_accept(environ):
@@ -140,8 +164,15 @@ def make_app(scoring_service=None, hooks=None):
     """
     service = scoring_service or ScoringService()
     hooks = hooks or {}
+    # duck-typed services (tests, script-mode shims) may not carry one
+    breaker = getattr(service, "breaker", None)
+    from .batcher import JobQueueFull
 
     def handle_invocations(environ, start_response):
+        if breaker is not None and not breaker.allow():
+            # open breaker: shed before decode — the whole point is that a
+            # drowning instance stops paying per-request parse costs
+            return _shed_response(start_response, breaker, "shedding load")
         payload = _read_body(environ)
         if len(payload) == 0:
             return _response(start_response, http.client.NO_CONTENT)
@@ -186,6 +217,17 @@ def make_app(scoring_service=None, hooks=None):
                 preds = hooks["predict_fn"](dtest, model)
             else:
                 preds = service.predict(dtest, parsed_type)
+        except (JobQueueFull, TimeoutError) as e:
+            # saturation, not a client error: 503 + Retry-After (MMS parity —
+            # the reference's frontend 503s on a full job queue) and feed the
+            # breaker so a sustained storm flips /ping and sheds pre-decode
+            logger.warning("predict saturated: %s", e)
+            if breaker is not None:
+                breaker.record_saturation()
+                return _shed_response(start_response, breaker, str(e))
+            return _response(
+                start_response, http.client.SERVICE_UNAVAILABLE, str(e)
+            )
         except Exception as e:
             logger.exception("predict failed")
             return _response(
@@ -193,6 +235,8 @@ def make_app(scoring_service=None, hooks=None):
                 http.client.BAD_REQUEST,
                 "Unable to evaluate payload provided: %s" % e,
             )
+        if breaker is not None:
+            breaker.record_success()
 
         if "output_fn" in hooks:
             try:
@@ -242,6 +286,17 @@ def make_app(scoring_service=None, hooks=None):
         method = environ.get("REQUEST_METHOD", "GET")
         try:
             if path == "/ping" and method == "GET":
+                if breaker is not None and breaker.degraded:
+                    # flip readiness while shedding: the platform should
+                    # stop routing to this instance until it recovers
+                    return _response(
+                        start_response,
+                        http.client.SERVICE_UNAVAILABLE,
+                        "degraded: shedding load",
+                        extra_headers=[
+                            ("Retry-After", str(breaker.retry_after_s()))
+                        ],
+                    )
                 try:
                     _hooked_model(service, hooks)
                     return _response(start_response, http.client.OK)

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..models.forest import _host_predict_rows
 from ..telemetry import POW2_BUCKETS, REGISTRY, get_request_id
+from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
 
@@ -255,6 +256,10 @@ class PredictBatcher:
                 if len(batch) > 1:
                     self._m_coalesced.inc(len(batch))
                 try:
+                    # chaos hook: a sleep here wedges the dispatch worker
+                    # (tunneled-TPU stall), backing the queue up into
+                    # JobQueueFull — the breaker drill's saturation source
+                    fault_point("batcher.dispatch", requests=len(batch))
                     stacked = (
                         batch[0].features
                         if len(batch) == 1

@@ -260,8 +260,17 @@ def _invoke(manager, name, environ, start_response):
             preds = serve_utils.predict(
                 model, fmt, dtest, parsed_type, objective=first.objective_name
             )
-    except JobQueueFull as e:
-        return _response(start_response, http.client.SERVICE_UNAVAILABLE, str(e))
+    except (JobQueueFull, TimeoutError) as e:
+        # saturation: 503 with a Retry-After hint (same shed contract as the
+        # single-model app; the per-model queue bound is the MMS analog)
+        from .breaker import retry_after_hint
+
+        return _response(
+            start_response,
+            http.client.SERVICE_UNAVAILABLE,
+            str(e),
+            extra_headers=[("Retry-After", str(retry_after_hint()))],
+        )
     except Exception as e:
         logger.exception("invoke predict failed")
         return _response(start_response, http.client.BAD_REQUEST, str(e))

@@ -35,11 +35,9 @@ creating a single thread or socket.
 """
 
 import collections
-import json
 import logging
 import os
 import socket
-import struct
 import threading
 import time
 
@@ -383,34 +381,13 @@ class HeartbeatSender:
 
 
 def _recv_frame_bounded(sock, timeout):
-    """Read one length-prefixed JSON frame under a TOTAL deadline.
+    """One frame under a TOTAL deadline (trickle-proof) with the heartbeat
+    size cap. The deadline machinery lives in ``recv_message_bounded``
+    (parallel/distributed.py) — one implementation for every control-plane
+    reader (rendezvous, heartbeats, abort frames)."""
+    from ..parallel.distributed import recv_message_bounded
 
-    ``recv_message``'s per-recv timeout resets on every chunk, so a peer
-    trickling one byte per timeout window could hold the single-threaded
-    accept loop indefinitely — starving heartbeat folding and making every
-    other host look stale. The length prefix is also sanity-capped: a stray
-    HTTP client's request line parses as a ~500MB u32, which must be
-    rejected before blocking or allocating on it.
-    """
-    deadline = time.monotonic() + timeout
-
-    def _read(n):
-        buf = b""
-        while len(buf) < n:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise socket.timeout("frame read deadline exceeded")
-            sock.settimeout(remaining)
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("peer closed")
-            buf += chunk
-        return buf
-
-    (length,) = struct.unpack("<I", _read(4))
-    if length > _MAX_FRAME_BYTES:
-        raise ValueError("oversized heartbeat frame ({} bytes)".format(length))
-    return json.loads(_read(length).decode())
+    return recv_message_bounded(sock, timeout, max_bytes=_MAX_FRAME_BYTES)
 
 
 # --------------------------------------------------------------- aggregator
@@ -428,11 +405,17 @@ class HeartbeatAggregator:
         factor=None,
         stale_after=None,
         hosts=None,
+        on_stale=None,
     ):
         self.num_hosts = num_hosts
         self.interval = float(interval)
         self.factor = factor if factor is not None else straggler_factor()
         self.stale_after = stale_after if stale_after is not None else stale_heartbeats()
+        # detection -> action hook: called once per stale episode with
+        # (rank, host, age_s). The supervision layer (training/watchdog.py)
+        # plugs coordinate_abort in here; default None keeps PR-2 semantics
+        # (observe + warn only).
+        self.on_stale = on_stale
         self._reg = registry or REGISTRY
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -622,6 +605,11 @@ class HeartbeatAggregator:
                     age_s=round(age, 1),
                     threshold_s=round(self.stale_after * self.interval, 1),
                 )
+                if self.on_stale is not None:
+                    try:
+                        self.on_stale(rank, host, age)
+                    except Exception:
+                        logger.exception("on_stale hook failed; detection continues")
             else:
                 logger.info("host %s (rank %d) heartbeats resumed", host, rank)
         else:
@@ -796,6 +784,25 @@ def start_cluster_telemetry(hosts, current_host, registry=None):
     aggregator = None
     metrics_server = None
     if rank == 0:
+        on_stale = None
+        from ..training.watchdog import abort_on_stale_enabled
+
+        if abort_on_stale_enabled():
+            # promote detection into action: one abort broadcast + local
+            # abort per stale episode. Lazy import inside the hook keeps
+            # the telemetry package import-cycle-free.
+            def on_stale(stale_rank, stale_host, age_s):
+                from ..training.watchdog import coordinate_abort
+
+                coordinate_abort(
+                    ordered,
+                    current_host,
+                    "stale_host",
+                    stale_rank=stale_rank,
+                    stale_host=stale_host,
+                    age_s=round(age_s, 1),
+                )
+
         try:
             aggregator = HeartbeatAggregator(
                 num_hosts=len(ordered),
@@ -803,6 +810,7 @@ def start_cluster_telemetry(hosts, current_host, registry=None):
                 port=port,
                 registry=registry,
                 hosts=ordered,
+                on_stale=on_stale,
             ).start()
         except OSError as e:
             logger.warning(

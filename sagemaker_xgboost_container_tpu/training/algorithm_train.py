@@ -160,10 +160,15 @@ def sagemaker_train(
             include_in_training = False
         def _pre_exec(participating_hosts, current_host):
             # order matters: jax.distributed first (it must precede any JAX
-            # computation), then the heartbeat plane over the RE-FORMED
-            # cluster — ranks must match the participating host list, not
-            # the original SM_HOSTS (hosts without data already exited)
+            # computation), then the abort listener (it must be up before
+            # rank 0's aggregator can ever decide to broadcast), then the
+            # heartbeat plane over the RE-FORMED cluster — ranks must match
+            # the participating host list, not the original SM_HOSTS
+            # (hosts without data already exited)
             maybe_init_jax_distributed(participating_hosts, current_host)
+            from .watchdog import start_abort_plane
+
+            start_abort_plane(participating_hosts, current_host)
             start_cluster_telemetry(participating_hosts, current_host)
 
         distributed.distributed_run(

@@ -198,6 +198,15 @@ def get_callbacks(
             )
         )
 
+    # round watchdog (SM_ROUND_DEADLINE_S): every rank supervises its own
+    # round progress — a dead peer stalls ALL ranks' collectives, so each
+    # flushes and exits on its own rather than waiting on a coordinator
+    from .watchdog import maybe_round_watchdog
+
+    watchdog = maybe_round_watchdog()
+    if watchdog is not None:
+        callbacks.append(watchdog)
+
     # LAST: each round's record must drain the phases the callbacks above
     # recorded for that same round. Per-round log lines stay opt-in
     # (SM_ROUND_TIMING); the structured record is the telemetry contract.

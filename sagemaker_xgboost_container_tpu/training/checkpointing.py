@@ -14,6 +14,7 @@ Contract parity with the reference (checkpointing.py:139-453):
   fresh model behind.
 """
 
+import json
 import logging
 import os
 import queue
@@ -21,6 +22,10 @@ import re
 import tempfile
 import threading
 import time
+import weakref
+
+from ..utils.faults import fault_point
+from ..utils.retry import retry_transient
 
 TEMP_FILE_SUFFIX = ".sagemaker-ignore"
 FILE_LOCK_SUFFIX = ".sagemaker-uploading"
@@ -30,30 +35,105 @@ CHECKPOINT_FILENAME = "xgboost-checkpoint"
 
 logger = logging.getLogger(__name__)
 
+# live SaveCheckpointCallBack instances, for the abort path's final flush
+# (training/watchdog.request_abort) — weak so a completed training run's
+# callback doesn't linger here
+_active_savers = weakref.WeakSet()
+
+
+def _checkpoint_usable(path):
+    """Cheap integrity check for a checkpoint file.
+
+    Checkpoints are full serialized models (forest/gblinear both emit JSON;
+    the ``.ubj`` branch only triggers on an explicit suffix, which the
+    extension-less ``xgboost-checkpoint.<iter>`` names never carry). A file
+    killed mid-write — crash between temp-create and rename shouldn't leave
+    one, but an interrupted upload-restore or disk-full truncation can — is
+    empty or cuts off mid-JSON; both fail the parse.
+    """
+    try:
+        if os.path.getsize(path) == 0:
+            return False
+        with open(path, "rb") as f:
+            json.loads(f.read().decode("utf-8"))
+        return True
+    except (OSError, ValueError, UnicodeDecodeError):
+        return False
+
 
 def load_checkpoint(checkpoint_dir):
-    """-> (model path or None, next iteration number)."""
+    """-> (model path or None, next iteration number).
+
+    Picks the highest-iteration checkpoint that actually *loads* — a
+    corrupt/partial file (crash or interrupted upload-restore) is skipped
+    with a warning and the next-highest takes over, so one bad file can't
+    turn a resumable job into a from-scratch retrain or a crash loop. Also
+    sweeps orphaned ``.sagemaker-ignore`` temp files left by a crash
+    mid-``_atomic_save``.
+    """
     if not checkpoint_dir or not os.path.exists(checkpoint_dir):
         return None, 0
     pattern = re.compile(r"^{}\.([0-9]+)$".format(re.escape(CHECKPOINT_FILENAME)))
     found = []
     for name in os.listdir(checkpoint_dir):
+        if name.endswith(TEMP_FILE_SUFFIX):
+            try:
+                os.remove(os.path.join(checkpoint_dir, name))
+                logger.info("removed orphaned checkpoint temp file %s", name)
+            except OSError:
+                logger.debug("could not remove orphaned temp file %s", name)
+            continue
         m = pattern.match(name)
         if m:
             found.append((int(m.group(1)), name))
-    if not found:
-        return None, 0
-    iteration, name = max(found)
-    return os.path.join(checkpoint_dir, name), iteration + 1
+    for iteration, name in sorted(found, reverse=True):
+        path = os.path.join(checkpoint_dir, name)
+        if _checkpoint_usable(path):
+            return path, iteration + 1
+        logger.warning(
+            "checkpoint %s is corrupt or partial; falling back to the "
+            "next-highest iteration", name
+        )
+    return None, 0
 
 
 def _atomic_save(model, directory, final_name):
-    with tempfile.NamedTemporaryFile(
-        dir=directory, suffix=TEMP_FILE_SUFFIX, delete=False, mode="w"
-    ) as tf:
-        tmp = tf.name
-    model.save_model(tmp)
-    os.rename(tmp, os.path.join(directory, final_name))
+    """tempfile + rename, with bounded transient-IO retries. Each attempt
+    uses a fresh temp file and cleans up its own debris on failure, so a
+    retried save can't leak ``.sagemaker-ignore`` orphans."""
+
+    def _attempt():
+        fault_point("checkpoint.save", path=final_name)
+        with tempfile.NamedTemporaryFile(
+            dir=directory, suffix=TEMP_FILE_SUFFIX, delete=False, mode="w"
+        ) as tf:
+            tmp = tf.name
+        try:
+            model.save_model(tmp)
+            os.rename(tmp, os.path.join(directory, final_name))
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    retry_transient(_attempt, site="checkpoint.save")
+
+
+def flush_checkpoints(timeout=10.0):
+    """Abort-path flush: drain every live checkpoint deleter queue so the
+    newest checkpoint files are settled on disk before the process exits
+    (the per-round saves themselves are synchronous — the last completed
+    round is already durable; this stops the background machinery cleanly).
+    The join is bounded: when the deleter itself is wedged on the hung
+    storage that triggered the abort, the exit must still happen.
+    """
+    for saver in list(_active_savers):
+        try:
+            saver.stop(timeout=timeout)
+        except Exception:
+            logger.exception("checkpoint flush failed for %r", saver)
 
 
 class SaveCheckpointCallBack:
@@ -72,6 +152,7 @@ class SaveCheckpointCallBack:
         }
         self.delete_queue = queue.Queue()
         self._start_deleter()
+        _active_savers.add(self)
 
     def format_path(self, iteration):
         return os.path.join(
@@ -129,10 +210,14 @@ class SaveCheckpointCallBack:
         self.thread = threading.Thread(target=_drain, daemon=True)
         self.thread.start()
 
-    def stop(self):
+    def stop(self, timeout=None):
+        """Drain and join the deleter. ``timeout`` bounds the join for the
+        abort path — a deleter wedged on hung storage must not keep the
+        process from its exit (normal end-of-training keeps the full
+        blocking drain)."""
         if self.thread.is_alive():
             self.delete_queue.put(self.SENTINEL)
-            self.thread.join()
+            self.thread.join(timeout)
 
 
 class SaveIntermediateModelCallBack:

@@ -29,6 +29,7 @@ from ..telemetry import (
     push_recorder,
 )
 from ..telemetry import percentile  # noqa: F401  (canonical home: telemetry.registry)
+from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +68,10 @@ class RoundTimer:
         return model
 
     def after_iteration(self, model, epoch, evals_log):
+        # chaos hook: the one per-round fault point every training run owns
+        # (RoundTimer is always in the stack) — lets drills stall a round
+        # (watchdog tests) or deliver SIGTERM mid-training deterministically
+        fault_point("training.round_end", round=epoch)
         now = time.perf_counter()
         if self._last is not None:
             elapsed = now - self._last

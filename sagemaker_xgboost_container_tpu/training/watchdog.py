@@ -1,0 +1,230 @@
+"""Failure-domain supervision: round watchdog + coordinated abort.
+
+The jitted round step psums gradient histograms across every host in the
+mesh, so a single wedged device or dead peer leaves *all* surviving ranks
+blocked inside a collective forever — the job burns its full time budget
+doing nothing, and spot-safe checkpoints never get their final flush. The
+cluster telemetry plane (telemetry/cluster.py) can *see* the failure; this
+module *acts* on it, closing the detect->decide->recover loop:
+
+* **RoundWatchdog** — a booster-protocol callback plus a monitor thread.
+  Every ``after_iteration`` pets the watchdog; if no round completes within
+  ``SM_ROUND_DEADLINE_S`` the process flushes the checkpoint machinery,
+  emits one ``training.abort`` record, and hard-exits with
+  ``EXIT_ROUND_DEADLINE`` (79) so the platform restarts the job and
+  ``load_checkpoint`` resumes at the last saved round.
+* **request_abort** — the one local abort path, shared by the watchdog, the
+  abort listener, and rank 0's stale-host decision. Idempotent: concurrent
+  triggers (watchdog firing while an abort frame arrives) flush once and
+  exit once.
+* **abort plane** (``SM_ABORT_ON_STALE``) — every participating host runs an
+  ``AbortListener`` (parallel/distributed.py); when rank 0's heartbeat
+  aggregator declares a host stale it broadcasts one abort frame to every
+  peer and aborts itself with ``EXIT_CLUSTER_ABORT`` (80), so the whole
+  cluster exits cleanly instead of deadlocking in the psum.
+
+The main thread is typically *inside* a jitted collective when any of this
+fires, which is why the exit is ``os._exit`` from a supervisor thread:
+there is no way to unwind a blocked XLA dispatch from Python.
+
+Everything is env-gated and inert by default: no deadline -> no watchdog
+thread; ``SM_ABORT_ON_STALE`` unset -> no listener socket.
+"""
+
+import logging
+import os
+import threading
+import time
+
+from ..constants import EXIT_CLUSTER_ABORT, EXIT_ROUND_DEADLINE
+from ..telemetry.emit import emit_metric
+from ..utils.envconfig import env_bool, env_float
+from . import checkpointing
+
+logger = logging.getLogger(__name__)
+
+ROUND_DEADLINE_ENV = "SM_ROUND_DEADLINE_S"
+ABORT_ON_STALE_ENV = "SM_ABORT_ON_STALE"
+
+# test hook: chaos tests replace this to observe the exit instead of dying
+_exit = os._exit
+
+_abort_lock = threading.Lock()
+_aborting = False
+
+
+def round_deadline_s():
+    return env_float(ROUND_DEADLINE_ENV, 0.0, minimum=0.0)
+
+
+def abort_on_stale_enabled():
+    return env_bool(ABORT_ON_STALE_ENV, False)
+
+
+def request_abort(reason, exit_code, **fields):
+    """Flush checkpoints, emit one ``training.abort`` record, hard-exit.
+
+    Safe to call from any thread (and designed to be — the caller is a
+    supervisor thread while the main thread is wedged). First caller wins;
+    later triggers return immediately so racing supervisors can't
+    double-flush or fight over the exit code.
+    """
+    global _aborting
+    with _abort_lock:
+        if _aborting:
+            return
+        _aborting = True
+    logger.error(
+        "ABORTING training (%s, exit code %d): flushing checkpoints and "
+        "exiting so the platform can restart and resume", reason, exit_code
+    )
+    try:
+        checkpointing.flush_checkpoints()
+    except Exception:
+        logger.exception("checkpoint flush during abort failed; exiting anyway")
+    emit_metric("training.abort", reason=reason, exit_code=exit_code, **fields)
+    _exit(exit_code)
+
+
+def _reset_abort_for_tests():
+    global _aborting
+    with _abort_lock:
+        _aborting = False
+
+
+class RoundWatchdog:
+    """Deadline supervisor riding the booster callback protocol.
+
+    ``before_training`` arms it (the first deadline window also covers the
+    initial XLA compile — size ``SM_ROUND_DEADLINE_S`` accordingly);
+    ``after_iteration`` pets it; ``after_training`` disarms it. The monitor
+    thread wakes at ``deadline/4`` granularity, so detection latency is at
+    most ~1.25x the deadline.
+    """
+
+    def __init__(self, deadline_s, on_expire=None, check_interval=None):
+        self.deadline_s = float(deadline_s)
+        self.on_expire = on_expire or self._default_expire
+        self.check_interval = check_interval or max(self.deadline_s / 4.0, 0.05)
+        self._last_pet = None
+        self._round = -1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ----------------------------------------------------- callback protocol
+    def before_training(self, model):
+        with self._lock:
+            self._last_pet = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="round-watchdog"
+        )
+        self._thread.start()
+        logger.info(
+            "round watchdog armed: abort if any round exceeds %.1fs",
+            self.deadline_s,
+        )
+        return model
+
+    def after_iteration(self, model, epoch, evals_log):
+        with self._lock:
+            self._last_pet = time.monotonic()
+            self._round = epoch
+        return False
+
+    def after_training(self, model):
+        self.stop()
+        return model
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------- internals
+    def _run(self):
+        while not self._stop.wait(self.check_interval):
+            with self._lock:
+                last, rnd = self._last_pet, self._round
+            if last is None:
+                continue
+            stalled = time.monotonic() - last
+            if stalled > self.deadline_s:
+                self.on_expire(rnd, stalled)
+                return
+
+    def _default_expire(self, last_round, stalled_s):
+        logger.error(
+            "round watchdog expired: no round completed for %.1fs "
+            "(deadline %.1fs, last finished round %d) — device hang or dead "
+            "peer stalling the collective",
+            stalled_s,
+            self.deadline_s,
+            last_round,
+        )
+        request_abort(
+            "round_deadline",
+            EXIT_ROUND_DEADLINE,
+            last_round=last_round,
+            stalled_s=round(stalled_s, 1),
+            deadline_s=self.deadline_s,
+        )
+
+
+def maybe_round_watchdog():
+    """-> a RoundWatchdog when ``SM_ROUND_DEADLINE_S`` > 0, else None."""
+    deadline = round_deadline_s()
+    if deadline <= 0:
+        return None
+    return RoundWatchdog(deadline)
+
+
+# ------------------------------------------------------------- abort plane
+def _on_abort_frame(msg):
+    request_abort(
+        str(msg.get("reason", "cluster_abort")),
+        EXIT_CLUSTER_ABORT,
+        source=msg.get("source"),
+    )
+
+
+def start_abort_plane(hosts, current_host):
+    """Start this host's abort listener (gated on ``SM_ABORT_ON_STALE``).
+
+    Every participant — including rank 0, for one uniform code path — gets
+    a listener; rank 0 additionally wires the heartbeat aggregator's
+    stale-host detection to :func:`coordinate_abort` (telemetry/cluster.py).
+    Returns the listener or None when the plane is disabled.
+    """
+    if not abort_on_stale_enabled():
+        return None
+    if len(hosts) <= 1:
+        return None
+    from ..parallel.distributed import AbortListener
+
+    try:
+        listener = AbortListener(handler=_on_abort_frame).start()
+    except OSError as e:
+        logger.warning(
+            "abort listener could not bind (%s); this host will rely on the "
+            "jax.distributed heartbeat timeout instead", e
+        )
+        return None
+    logger.info(
+        "abort listener up on port %d (host %s)", listener.port, current_host
+    )
+    return listener
+
+
+def coordinate_abort(hosts, current_host, reason, **fields):
+    """Rank 0: broadcast one abort frame to every peer, then abort locally."""
+    from ..parallel.distributed import broadcast_abort
+
+    peers = [h for h in hosts if h != current_host]
+    delivered = broadcast_abort(peers, reason, source=current_host)
+    logger.error(
+        "coordinated abort (%s): notified %d/%d peers", reason, delivered, len(peers)
+    )
+    request_abort(reason, EXIT_CLUSTER_ABORT, peers_notified=delivered, **fields)

@@ -1,0 +1,191 @@
+"""Deterministic fault injection for chaos tests and failure drills.
+
+None of the container's failure paths (watchdog abort, retrying readers,
+load-shedding, SIGTERM model flush) are testable against *real* hardware
+faults — a wedged TPU host or a mid-upload kill cannot be scripted in CI.
+This module gives every failure path a named **fault point**: production
+code calls ``fault_point("data.read", path=...)`` at the spot where the
+real world could misbehave, and the ``SM_FAULT_SPEC`` env var (or a direct
+``configure()`` call in tests) arms deterministic misbehavior there.
+
+Spec grammar (entries separated by ``;`` or ``,``)::
+
+    SM_FAULT_SPEC = "<point>:<action>[:<param>][@<n>|@<n>+] [; ...]"
+
+    data.read:error:boom          every hit raises OSError("boom")
+    data.read:error@2             only the 2nd hit raises
+    checkpoint.save:error@3+      3rd hit and every one after
+    training.round_end:sleep:30   every round stalls 30s (watchdog drills)
+    training.round_end:sigterm@3  3rd round delivers SIGTERM to this process
+    sync.accept:drop              raises ConnectionError (socket drop)
+    batcher.dispatch:exit:9       hard-exits the process (host death)
+
+Actions: ``error[:msg]`` -> OSError, ``drop`` -> ConnectionError,
+``sleep:<seconds>``, ``sigterm`` (os.kill SIGTERM), ``exit:<code>``
+(``os._exit`` — simulated host death, no cleanup).
+
+**Zero overhead when unarmed**: with ``SM_FAULT_SPEC`` unset the module
+global stays ``None`` and ``fault_point`` is a single attribute read and
+return — no dict lookup, no lock, no allocation. Malformed spec entries
+are skipped with one warning each (a typo in a chaos drill must not take
+down the job being drilled).
+"""
+
+import logging
+import os
+import signal
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+FAULT_SPEC_ENV = "SM_FAULT_SPEC"
+
+_ACTIONS = ("error", "drop", "sleep", "sigterm", "exit")
+
+# None = inert (the common case); else {point: [_Rule, ...]}
+_ACTIVE = None
+
+
+class _Rule:
+    """One armed fault: an action bound to a point with a hit window."""
+
+    def __init__(self, point, action, param=None, start=1, only=None):
+        self.point = point
+        self.action = action
+        self.param = param
+        self.start = start  # first hit (1-based) the rule fires on
+        self.only = only    # fire on exactly this hit, or None for start+
+        self.hits = 0
+        self.fired = 0
+        self._lock = threading.Lock()
+
+    def fire(self, ctx):
+        with self._lock:
+            self.hits += 1
+            hit = self.hits
+        if self.only is not None:
+            if hit != self.only:
+                return
+        elif hit < self.start:
+            return
+        with self._lock:
+            self.fired += 1
+        logger.warning(
+            "fault injected at %r (hit %d): %s%s ctx=%r",
+            self.point,
+            hit,
+            self.action,
+            ":{}".format(self.param) if self.param is not None else "",
+            ctx,
+        )
+        if self.action == "error":
+            raise OSError(self.param or "fault-injected IO error at {}".format(self.point))
+        if self.action == "drop":
+            raise ConnectionError(
+                self.param or "fault-injected connection drop at {}".format(self.point)
+            )
+        if self.action == "sleep":
+            time.sleep(float(self.param))
+            return
+        if self.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            # give the handler a beat to run before the caller proceeds
+            time.sleep(float(self.param) if self.param else 5.0)
+            return
+        if self.action == "exit":
+            os._exit(int(self.param) if self.param else 1)
+
+
+def _parse_entry(entry):
+    """``point:action[:param][@n[+]]`` -> _Rule (raises ValueError)."""
+    entry = entry.strip()
+    if not entry:
+        return None
+    spec, start, only = entry, 1, None
+    if "@" in entry:
+        spec, _, trigger = entry.rpartition("@")
+        trigger = trigger.strip()
+        if trigger.endswith("+"):
+            start = int(trigger[:-1])
+        else:
+            only = int(trigger)
+        if (only is not None and only < 1) or start < 1:
+            raise ValueError("hit trigger must be >= 1")
+    parts = spec.split(":", 2)
+    if len(parts) < 2:
+        raise ValueError("expected <point>:<action>")
+    point, action = parts[0].strip(), parts[1].strip()
+    param = parts[2].strip() if len(parts) == 3 else None
+    if not point or action not in _ACTIONS:
+        raise ValueError("unknown action {!r} (one of {})".format(action, _ACTIONS))
+    if action == "sleep":
+        float(param)  # validate eagerly, not at fire time
+    if action == "exit" and param is not None:
+        int(param)
+    return _Rule(point, action, param=param, start=start, only=only)
+
+
+def configure(spec):
+    """(Re)arm the harness from a spec string; ``None``/empty disarms.
+
+    Malformed entries are skipped with a warning — a chaos drill with a
+    typo'd entry still injects its valid ones.
+    """
+    global _ACTIVE
+    if not spec or not spec.strip():
+        _ACTIVE = None
+        return None
+    rules = {}
+    for raw in spec.replace(";", ",").split(","):
+        try:
+            rule = _parse_entry(raw)
+        except (ValueError, TypeError) as e:
+            logger.warning("ignoring malformed %s entry %r: %s", FAULT_SPEC_ENV, raw, e)
+            continue
+        if rule is not None:
+            rules.setdefault(rule.point, []).append(rule)
+    _ACTIVE = rules or None
+    if _ACTIVE:
+        logger.warning(
+            "fault injection ARMED at %d point(s): %s",
+            len(_ACTIVE),
+            ", ".join(sorted(_ACTIVE)),
+        )
+    return _ACTIVE
+
+
+def configure_from_env():
+    """Arm from ``SM_FAULT_SPEC`` (called once at import; tests re-call)."""
+    return configure(os.getenv(FAULT_SPEC_ENV))
+
+
+def reset():
+    """Disarm every fault (test teardown)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def fault_counts():
+    """-> {point: total fires} for armed points (test assertions)."""
+    active = _ACTIVE
+    if not active:
+        return {}
+    return {
+        point: sum(r.fired for r in rules) for point, rules in active.items()
+    }
+
+
+def fault_point(name, **ctx):
+    """Declare a named fault point. Inert (one global read) unless armed."""
+    active = _ACTIVE
+    if active is None:
+        return
+    rules = active.get(name)
+    if not rules:
+        return
+    for rule in rules:
+        rule.fire(ctx)
+
+
+configure_from_env()

@@ -28,10 +28,14 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-# static no-print gate (tox.ini parity): telemetry goes through the
-# registry/logger, not stray stdout writes
+# static gates (tox.ini parity): telemetry goes through the registry/logger
+# (no stray prints) and every except names a type (no bare excepts that
+# could eat the supervision layer's control-flow exceptions)
 python "$REPO/scripts/check_no_print.py" || {
   echo "CI $TIER TIER FAILED (check_no_print)"; exit 1;
+}
+python "$REPO/scripts/check_no_bare_except.py" || {
+  echo "CI $TIER TIER FAILED (check_no_bare_except)"; exit 1;
 }
 
 case "$TIER" in
@@ -43,8 +47,11 @@ case "$TIER" in
     PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
       python -m pytest tests/ -q "${COV_ARGS[@]}"
     ;;
+  chaos)
+    python -m pytest tests/ -q -m chaos
+    ;;
   *)
-    echo "usage: $0 [fast|full]"; exit 2
+    echo "usage: $0 [fast|full|chaos]"; exit 2
     ;;
 esac
 rc=$?

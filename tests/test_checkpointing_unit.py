@@ -44,8 +44,10 @@ def test_load_checkpoint_missing_dir(tmp_path):
 
 
 def test_load_checkpoint_picks_highest_iteration(tmp_path):
+    # checkpoints must be loadable (JSON) to be picked — see the
+    # corrupt-fallback tests in test_robustness.py
     for it in (0, 3, 11):
-        (tmp_path / "xgboost-checkpoint.{}".format(it)).write_text("x")
+        (tmp_path / "xgboost-checkpoint.{}".format(it)).write_text("{}")
     (tmp_path / "unrelated.file").write_text("x")
     path, nxt = checkpointing.load_checkpoint(str(tmp_path))
     assert path.endswith("xgboost-checkpoint.11")
@@ -195,7 +197,7 @@ def test_recorder_rejects_ndim_switch(tmp_path):
 def test_get_callbacks_assembly_and_resume(tmp_path):
     ckpt = tmp_path / "ckpt"
     ckpt.mkdir()
-    (ckpt / "xgboost-checkpoint.4").write_text("x")
+    (ckpt / "xgboost-checkpoint.4").write_text("{}")
     xgb_model, iteration, cbs = get_callbacks(
         model_dir=str(tmp_path / "model"),
         checkpoint_dir=str(ckpt),

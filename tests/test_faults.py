@@ -1,0 +1,158 @@
+"""Unit tier for the fault-injection harness (utils/faults.py) and the
+transient-retry policy (utils/retry.py) it exists to exercise."""
+
+import time
+
+import pytest
+
+from sagemaker_xgboost_container_tpu.utils import faults
+from sagemaker_xgboost_container_tpu.utils.retry import retry_transient
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------------ harness
+
+
+def test_unset_spec_is_inert():
+    faults.configure(None)
+    assert faults._ACTIVE is None
+    # the no-op path: one global read, returns immediately
+    assert faults.fault_point("anything", key="value") is None
+    assert faults.fault_counts() == {}
+
+
+def test_error_action_every_hit():
+    faults.configure("data.read:error:boom")
+    for _ in range(3):
+        with pytest.raises(OSError, match="boom"):
+            faults.fault_point("data.read")
+    # other points stay clean
+    faults.fault_point("checkpoint.save")
+    assert faults.fault_counts() == {"data.read": 3}
+
+
+def test_nth_hit_trigger_fires_exactly_once():
+    faults.configure("p:error@2")
+    faults.fault_point("p")  # hit 1: pass
+    with pytest.raises(OSError):
+        faults.fault_point("p")  # hit 2: fire
+    faults.fault_point("p")  # hit 3: pass again
+    assert faults.fault_counts() == {"p": 1}
+
+
+def test_from_nth_hit_trigger():
+    faults.configure("p:drop@3+")
+    faults.fault_point("p")
+    faults.fault_point("p")
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            faults.fault_point("p")
+
+
+def test_sleep_action_and_multiple_entries():
+    faults.configure("a:sleep:0.05;b:error")
+    t0 = time.monotonic()
+    faults.fault_point("a")
+    assert time.monotonic() - t0 >= 0.05
+    with pytest.raises(OSError):
+        faults.fault_point("b")
+
+
+def test_malformed_entries_skipped_valid_ones_armed():
+    faults.configure("nonsense;p:frobnicate;q:error:ok;r:sleep:notanumber")
+    # only q:error survived parsing
+    faults.fault_point("p")
+    faults.fault_point("r")
+    with pytest.raises(OSError, match="ok"):
+        faults.fault_point("q")
+
+
+def test_configure_from_env(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "x:error")
+    faults.configure_from_env()
+    with pytest.raises(OSError):
+        faults.fault_point("x")
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    faults.configure_from_env()
+    assert faults._ACTIVE is None
+
+
+# -------------------------------------------------------------------- retry
+
+
+def _no_sleep(_):
+    pass
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("blip")
+        return "ok"
+
+    assert (
+        retry_transient(flaky, "t.site", attempts=3, backoff_s=0.0, sleep=_no_sleep)
+        == "ok"
+    )
+    assert calls["n"] == 3
+
+
+def test_retry_exhaustion_reraises_original():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry_transient(always, "t.down", attempts=2, backoff_s=0.0, sleep=_no_sleep)
+
+
+def test_retry_does_not_catch_semantic_errors():
+    def bad():
+        raise ValueError("parse error")
+
+    calls = []
+
+    def sleep(d):
+        calls.append(d)
+
+    with pytest.raises(ValueError):
+        retry_transient(bad, "t.sem", attempts=5, backoff_s=0.0, sleep=sleep)
+    assert calls == []  # no retry happened
+
+
+def test_retry_backoff_grows_with_jitter():
+    delays = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_transient(
+            always,
+            "t.backoff",
+            attempts=4,
+            backoff_s=1.0,
+            sleep=delays.append,
+            rng=lambda: 1.0,  # deterministic full jitter -> exact doubling
+        )
+    assert delays == [1.0, 2.0, 4.0]
+
+
+def test_retry_with_fault_injection_end_to_end():
+    faults.configure("io.op:error:injected@1")
+    calls = {"n": 0}
+
+    def op():
+        calls["n"] += 1
+        faults.fault_point("io.op")
+        return 42
+
+    assert retry_transient(op, "t.fi", attempts=3, backoff_s=0.0, sleep=_no_sleep) == 42
+    assert calls["n"] == 2  # first hit injected, second clean

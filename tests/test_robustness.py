@@ -1,0 +1,683 @@
+"""Chaos suite: failure-domain supervision under injected faults.
+
+Every test here drives a *failure* path — transient IO errors during
+ingest/checkpointing, corrupt checkpoints, trickling rendezvous peers,
+stale-host abort broadcasts, round-watchdog expiry (subprocess, real exit
+codes), SIGTERM mid-training, and batcher-saturation load shedding. The
+fault-injection harness (utils/faults.py) makes each deterministic.
+
+Marked ``chaos``: run alone with ``pytest -m chaos`` / ``tox -e chaos``;
+also part of the default (tier-1) selection.
+"""
+
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.constants import (
+    EXIT_CLUSTER_ABORT,
+    EXIT_ROUND_DEADLINE,
+)
+from sagemaker_xgboost_container_tpu.data.readers import get_data_matrix
+from sagemaker_xgboost_container_tpu.parallel.distributed import (
+    AbortListener,
+    Cluster,
+    broadcast_abort,
+    frame_message,
+)
+from sagemaker_xgboost_container_tpu.serving.app import make_app
+from sagemaker_xgboost_container_tpu.serving.batcher import JobQueueFull
+from sagemaker_xgboost_container_tpu.serving.breaker import CircuitBreaker
+from sagemaker_xgboost_container_tpu.telemetry.registry import MetricsRegistry
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+from sagemaker_xgboost_container_tpu.training import checkpointing, watchdog
+from sagemaker_xgboost_container_tpu.training.watchdog import RoundWatchdog
+from sagemaker_xgboost_container_tpu.utils import faults
+from tests.util_ports import free_port
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults(monkeypatch):
+    # chaos tests retry fast; the knob is read per retry_transient call
+    monkeypatch.setenv("SM_IO_RETRY_BACKOFF_S", "0.001")
+    yield
+    faults.reset()
+
+
+class _JsonModel:
+    """save_model contract emitting valid checkpoint JSON."""
+
+    def __init__(self, tag="m"):
+        self.tag = tag
+        self.attributes = {}
+
+    def save_model(self, path):
+        with open(path, "w") as f:
+            json.dump({"tag": self.tag}, f)
+
+
+# ---------------------------------------------------------------- ingest IO
+
+
+def _write_csv(dirpath, n=50, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3).astype(np.float32)
+    y = (X @ np.asarray([3.0, 1.0, 2.0], np.float32)).astype(np.float32)
+    os.makedirs(dirpath, exist_ok=True)
+    np.savetxt(
+        os.path.join(dirpath, "train.csv"),
+        np.column_stack([y, X]),
+        delimiter=",",
+        fmt="%.6f",
+    )
+
+
+def test_reader_retries_through_transient_io_error(tmp_path):
+    data = str(tmp_path / "data")
+    _write_csv(data)
+    faults.configure("data.read:error:simulated S3 blip@1")
+    dm = get_data_matrix(data, "text/csv")
+    assert dm is not None and dm.num_row == 50
+    assert faults.fault_counts()["data.read"] == 1  # one injected, one retry
+
+
+def test_reader_exhausted_retries_fail_loudly(tmp_path):
+    data = str(tmp_path / "data")
+    _write_csv(data)
+    faults.configure("data.read:error:S3 down")
+    with pytest.raises(exc.UserError, match="Failed to load"):
+        get_data_matrix(data, "text/csv")
+    # every attempt hit the fault: the default budget, no infinite loop
+    from sagemaker_xgboost_container_tpu.utils.retry import retry_attempts
+
+    assert faults.fault_counts()["data.read"] == retry_attempts()
+
+
+# ------------------------------------------------------------- checkpointing
+
+
+def test_checkpoint_save_retries_and_leaves_no_orphans(tmp_path):
+    faults.configure("checkpoint.save:error:EBS blip@1")
+    checkpointing._atomic_save(_JsonModel("v1"), str(tmp_path), "xgboost-checkpoint.0")
+    assert json.loads((tmp_path / "xgboost-checkpoint.0").read_text()) == {"tag": "v1"}
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".sagemaker-ignore")]
+
+
+def test_load_checkpoint_falls_back_past_corrupt_files(tmp_path):
+    (tmp_path / "xgboost-checkpoint.0").write_text('{"tag": "good0"}')
+    (tmp_path / "xgboost-checkpoint.1").write_text('{"tag": "good1"}')
+    (tmp_path / "xgboost-checkpoint.2").write_text('{"trees": [')  # truncated
+    (tmp_path / "xgboost-checkpoint.3").write_text("")  # zero-length
+    path, iteration = checkpointing.load_checkpoint(str(tmp_path))
+    assert path.endswith("xgboost-checkpoint.1")
+    assert iteration == 2
+
+
+def test_load_checkpoint_sweeps_orphaned_temp_files(tmp_path):
+    (tmp_path / "xgboost-checkpoint.0").write_text("{}")
+    (tmp_path / "tmpXYZ.sagemaker-ignore").write_text("crash debris")
+    path, iteration = checkpointing.load_checkpoint(str(tmp_path))
+    assert path.endswith("xgboost-checkpoint.0") and iteration == 1
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".sagemaker-ignore")]
+
+
+def test_load_checkpoint_all_corrupt_means_fresh_start(tmp_path):
+    (tmp_path / "xgboost-checkpoint.5").write_text("not json")
+    assert checkpointing.load_checkpoint(str(tmp_path)) == (None, 0)
+
+
+# ------------------------------------------------------ rendezvous deadlines
+
+
+def test_synchronize_trickling_worker_raises_naming_missing_ranks():
+    """A worker that connects and stalls (or trickles bytes) used to hang
+    the master forever — only accept() was deadlined. Now the per-frame
+    deadline drops it and the collect deadline names the missing rank."""
+    port = free_port()
+    master = Cluster(["algo-1", "algo-2"], "algo-1", port=port)
+    errors = []
+
+    def run_master():
+        try:
+            master.synchronize({"host": "algo-1"}, timeout=3.0, recv_timeout=0.5)
+        except exc.PlatformError as e:
+            errors.append(e)
+
+    t = threading.Thread(target=run_master)
+    t.start()
+    time.sleep(0.3)  # let the master bind
+    # the trickling peer: half a length prefix, then silence
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    sock.sendall(b"\x10\x00")
+    t.join(timeout=15)
+    sock.close()
+    assert not t.is_alive(), "master must not hang on a trickling worker"
+    assert errors, "master must raise PlatformError"
+    message = str(errors[0])
+    assert "missing rank(s) [1]" in message
+    assert "algo-2" in message
+
+
+def test_synchronize_garbage_frame_does_not_block_rendezvous():
+    """A stray client sending a non-rendezvous frame is dropped; the real
+    worker still completes the allgather."""
+    port = free_port()
+    master = Cluster(["algo-1", "algo-2"], "algo-1", port=port)
+    results = {}
+
+    def run_master():
+        results["master"] = master.synchronize(
+            {"host": "algo-1"}, timeout=10.0, recv_timeout=1.0
+        )
+
+    def run_worker():
+        time.sleep(0.8)  # after the garbage client
+        worker = Cluster(["algo-1", "algo-2"], "algo-2", port=port)
+        # worker resolves master_host "algo-1" — patch via direct attribute
+        worker.master_host = "127.0.0.1"
+        results["worker"] = worker.synchronize({"host": "algo-2"}, timeout=10.0)
+
+    tm = threading.Thread(target=run_master)
+    tw = threading.Thread(target=run_worker)
+    tm.start()
+    time.sleep(0.3)
+    junk = socket.create_connection(("127.0.0.1", port), timeout=5)
+    junk.sendall(frame_message({"hello": "not a rendezvous payload"}))
+    junk.close()
+    # out-of-range rank: must be dropped, not fill a real rank's slot (or
+    # blow up the ordered[] assembly with a KeyError)
+    junk = socket.create_connection(("127.0.0.1", port), timeout=5)
+    junk.sendall(frame_message({"rank": 7, "payload": {"host": "impostor"}}))
+    junk.close()
+    tw.start()
+    tm.join(timeout=15)
+    tw.join(timeout=15)
+    assert results["master"] == [{"host": "algo-1"}, {"host": "algo-2"}]
+    assert results["worker"] == results["master"]
+
+
+# ---------------------------------------------------------- coordinated abort
+
+
+def test_abort_listener_receives_broadcast():
+    received = []
+    listener = AbortListener(handler=received.append, port=0).start()
+    try:
+        delivered = broadcast_abort(
+            ["127.0.0.1"], "stale_host", source="algo-1", port=listener.port
+        )
+        assert delivered == 1
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert received and received[0]["reason"] == "stale_host"
+        assert received[0]["source"] == "algo-1"
+    finally:
+        listener.stop()
+
+
+def test_abort_listener_ignores_junk_then_still_aborts():
+    received = []
+    listener = AbortListener(handler=received.append, port=0).start()
+    try:
+        # garbage bytes, then a non-abort frame: both dropped
+        s = socket.create_connection(("127.0.0.1", listener.port), timeout=5)
+        s.sendall(b"GET / HTTP/1.1\r\n\r\n")
+        s.close()
+        s = socket.create_connection(("127.0.0.1", listener.port), timeout=5)
+        s.sendall(frame_message({"type": "heartbeat"}))
+        s.close()
+        time.sleep(0.3)
+        assert received == []
+        assert broadcast_abort(["127.0.0.1"], "r", port=listener.port) == 1
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert received
+    finally:
+        listener.stop()
+
+
+def test_broadcast_abort_to_dead_host_is_best_effort():
+    # nothing listens on this port: delivery fails, nothing raises
+    assert broadcast_abort(["127.0.0.1"], "r", port=free_port(), timeout=0.5) == 0
+
+
+def test_request_abort_flushes_checkpoints_and_exits(tmp_path, monkeypatch, capsys):
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    watchdog._reset_abort_for_tests()
+    saver = checkpointing.SaveCheckpointCallBack(str(tmp_path))
+    saver.after_iteration(_JsonModel(), 0, {})
+    watchdog.request_abort("test_reason", EXIT_ROUND_DEADLINE, last_round=0)
+    assert codes == [EXIT_ROUND_DEADLINE]
+    assert not saver.thread.is_alive(), "deleter drained before exit"
+    record = [
+        json.loads(l)
+        for l in capsys.readouterr().out.splitlines()
+        if l.startswith('{"metric": "training.abort"')
+    ]
+    assert record and record[0]["reason"] == "test_reason"
+    assert record[0]["exit_code"] == EXIT_ROUND_DEADLINE
+    # idempotent: a racing second trigger is a no-op
+    watchdog.request_abort("again", EXIT_CLUSTER_ABORT)
+    assert codes == [EXIT_ROUND_DEADLINE]
+    watchdog._reset_abort_for_tests()
+
+
+def test_aggregator_stale_host_triggers_abort_hook():
+    from sagemaker_xgboost_container_tpu.telemetry.cluster import HeartbeatAggregator
+    from tests.util_cluster import make_heartbeat
+
+    events = []
+    reg = MetricsRegistry()
+    agg = HeartbeatAggregator(
+        num_hosts=2,
+        interval=0.1,
+        port=0,
+        registry=reg,
+        hosts=["algo-1", "algo-2"],
+        stale_after=1,
+        on_stale=lambda rank, host, age: events.append((rank, host)),
+    )
+    try:
+        agg.fold(make_heartbeat(1, host="algo-2"))
+        time.sleep(0.25)  # > stale_after * interval for every rank
+        agg.evaluate()
+        assert (1, "algo-2") in events
+        # edge-triggered: the same episode must not re-fire
+        agg.evaluate()
+        assert events.count((1, "algo-2")) == 1
+    finally:
+        agg._server.close()
+
+
+def test_abort_frame_handler_uses_cluster_exit_code(monkeypatch):
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    watchdog._reset_abort_for_tests()
+    watchdog._on_abort_frame({"type": "abort", "reason": "stale_host", "source": "algo-1"})
+    assert codes == [EXIT_CLUSTER_ABORT]
+    watchdog._reset_abort_for_tests()
+
+
+# -------------------------------------------------------------- round watchdog
+
+
+def test_round_watchdog_quiet_while_rounds_progress():
+    fired = []
+    wd = RoundWatchdog(0.5, on_expire=lambda r, s: fired.append(r), check_interval=0.05)
+    wd.before_training(None)
+    for epoch in range(4):
+        time.sleep(0.1)
+        wd.after_iteration(None, epoch, {})
+    wd.after_training(None)
+    assert fired == []
+    assert wd._thread is None  # monitor stopped with training
+
+
+def test_round_watchdog_fires_on_stalled_round():
+    fired = []
+    wd = RoundWatchdog(
+        0.2, on_expire=lambda r, s: fired.append((r, s)), check_interval=0.05
+    )
+    wd.before_training(None)
+    wd.after_iteration(None, 0, {})
+    try:
+        deadline = time.monotonic() + 5
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired, "watchdog must fire when no round completes"
+        last_round, stalled = fired[0]
+        assert last_round == 0 and stalled > 0.2
+    finally:
+        wd.stop()
+
+
+def test_maybe_round_watchdog_env_gate(monkeypatch):
+    monkeypatch.delenv(watchdog.ROUND_DEADLINE_ENV, raising=False)
+    assert watchdog.maybe_round_watchdog() is None
+    monkeypatch.setenv(watchdog.ROUND_DEADLINE_ENV, "12.5")
+    wd = watchdog.maybe_round_watchdog()
+    assert wd is not None and wd.deadline_s == 12.5
+
+
+# ----------------------------------------------------------- load shedding
+
+
+class _SaturableService:
+    """Duck-typed ScoringService whose predict saturates on demand."""
+
+    def __init__(self, breaker):
+        self.breaker = breaker
+        self.model = object()
+        self.model_format = "json"
+        self.saturated = True
+        self.predict_calls = 0
+
+    def load_model(self):
+        return self.model_format
+
+    def predict(self, dtest, content_type):
+        self.predict_calls += 1
+        if self.saturated:
+            raise JobQueueFull("job queue full (1 pending)")
+        return np.asarray([0.5])
+
+
+def _call(app, method, path, body=b"", content_type="text/csv"):
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "CONTENT_LENGTH": str(len(body)),
+        "CONTENT_TYPE": content_type,
+        "wsgi.input": io.BytesIO(body),
+    }
+    captured = {}
+
+    def start_response(status, headers, exc_info=None):
+        captured["status"] = status
+        captured["headers"] = headers
+
+    out = b"".join(app(environ, start_response))
+    status = int(captured["status"].split()[0])
+    headers = {k.lower(): v for k, v in captured["headers"]}
+    return status, headers, out
+
+
+def test_saturation_returns_503_with_retry_after_then_sheds_and_recovers():
+    reg = MetricsRegistry()
+    breaker = CircuitBreaker(
+        name="test", threshold=2, cooldown_s=0.3, registry=reg
+    )
+    service = _SaturableService(breaker)
+    app = make_app(service)
+
+    # healthy before the storm
+    assert _call(app, "GET", "/ping")[0] == 200
+
+    # saturated predicts: 503 + Retry-After on every one (MMS parity)
+    for _ in range(2):
+        status, headers, _ = _call(app, "POST", "/invocations", b"1.0,2.0,3.0")
+        assert status == 503
+        assert int(headers["retry-after"]) >= 1
+    assert breaker.state == "open"
+
+    # open breaker: shed BEFORE predict (fast path) and flip /ping
+    calls_before = service.predict_calls
+    status, headers, body = _call(app, "POST", "/invocations", b"1.0,2.0,3.0")
+    assert status == 503 and "retry-after" in headers
+    assert service.predict_calls == calls_before, "shed pre-decode, no predict"
+    ping_status, ping_headers, ping_body = _call(app, "GET", "/ping")
+    assert ping_status == 503 and b"degraded" in ping_body
+    assert reg.counter("serving_shed_total", labels={"breaker": "test"}).value >= 1
+
+    # cooldown passes, saturation clears: one probe closes the breaker
+    service.saturated = False
+    time.sleep(0.35)
+    status, _, body = _call(app, "POST", "/invocations", b"1.0,2.0,3.0")
+    assert status == 200, body
+    assert breaker.state == "closed"
+    assert _call(app, "GET", "/ping")[0] == 200
+
+
+def test_breaker_half_open_single_probe_and_reopen():
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(
+        name="probe",
+        threshold=1,
+        cooldown_s=10.0,
+        registry=MetricsRegistry(),
+        clock=lambda: clock["t"],
+    )
+    breaker.record_saturation()
+    assert breaker.state == "open"
+    assert not breaker.allow()  # still cooling down
+    clock["t"] = 11.0
+    assert breaker.allow()  # the probe
+    assert not breaker.allow()  # only ONE probe at a time
+    breaker.record_saturation()  # probe hit saturation again
+    assert breaker.state == "open"
+    clock["t"] = 22.0
+    assert breaker.allow()
+    breaker.record_success()
+    assert breaker.state == "closed"
+    assert breaker.allow() and breaker.allow()  # normal flow restored
+
+
+def test_breaker_disabled_never_sheds(monkeypatch):
+    monkeypatch.setenv("SM_LOAD_SHEDDING", "false")
+    breaker = CircuitBreaker(name="off", threshold=1, registry=MetricsRegistry())
+    breaker.record_saturation()
+    breaker.record_saturation()
+    assert breaker.allow() and not breaker.degraded
+
+
+# -------------------------------------------------- subprocess chaos drills
+
+
+def _sm_env(tmp_path, hyperparameters, data_dir, checkpoint_dir=None, extra=None):
+    conf = tmp_path / "input" / "config"
+    conf.mkdir(parents=True, exist_ok=True)
+    model_dir = tmp_path / "model"
+    output_dir = tmp_path / "output" / "data"
+    model_dir.mkdir(exist_ok=True)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    (conf / "hyperparameters.json").write_text(json.dumps(hyperparameters))
+    (conf / "inputdataconfig.json").write_text(
+        json.dumps(
+            {
+                "train": {
+                    "ContentType": "text/csv",
+                    "TrainingInputMode": "File",
+                    "S3DistributionType": "FullyReplicated",
+                }
+            }
+        )
+    )
+    if checkpoint_dir:
+        (conf / "checkpointconfig.json").write_text(
+            json.dumps({"LocalPath": str(checkpoint_dir)})
+        )
+    env = dict(os.environ)
+    env.pop("SM_FAULT_SPEC", None)
+    env.pop("SM_ROUND_DEADLINE_S", None)
+    env.update(
+        {
+            "SM_INPUT_TRAINING_CONFIG_FILE": str(conf / "hyperparameters.json"),
+            "SM_INPUT_DATA_CONFIG_FILE": str(conf / "inputdataconfig.json"),
+            "SM_CHECKPOINT_CONFIG_FILE": str(conf / "checkpointconfig.json"),
+            "SM_CHANNEL_TRAIN": str(data_dir),
+            "SM_MODEL_DIR": str(model_dir),
+            "SM_OUTPUT_DATA_DIR": str(output_dir),
+            "SM_HOSTS": '["algo-1"]',
+            "SM_CURRENT_HOST": "algo-1",
+            "JAX_PLATFORMS": "cpu",
+            # single CPU device: don't inherit conftest's 8-device forcing —
+            # the drills exercise supervision, not the mesh
+            "XLA_FLAGS": "",
+            "PYTHONPATH": REPO,
+        }
+    )
+    env.update(extra or {})
+    return env, model_dir
+
+
+def _run_train(env, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "sagemaker_xgboost_container_tpu.training.entry"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+HPS = {
+    "num_round": "6",
+    "max_depth": "2",
+    "objective": "reg:squarederror",
+    "eval_metric": "rmse",
+}
+
+
+def test_watchdog_aborts_stalled_round_and_restart_resumes(tmp_path):
+    """Acceptance drill: a wedged round -> checkpoint flushed, one
+    ``training.abort`` record, exit code EXIT_ROUND_DEADLINE; a restarted
+    job resumes from the checkpoint instead of starting over."""
+    data = tmp_path / "data"
+    _write_csv(str(data), n=200)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    env, model_dir = _sm_env(
+        tmp_path,
+        HPS,
+        data,
+        checkpoint_dir=ckpt,
+        extra={
+            # 3rd round wedges for far longer than the 10s deadline (the
+            # generous deadline keeps the first-round XLA compile safe)
+            "SM_FAULT_SPEC": "training.round_end:sleep:300@3",
+            "SM_ROUND_DEADLINE_S": "10",
+        },
+    )
+    result = _run_train(env)
+    assert result.returncode == EXIT_ROUND_DEADLINE, (
+        result.returncode,
+        result.stdout[-2000:],
+        result.stderr[-2000:],
+    )
+    abort_records = [
+        json.loads(l)
+        for l in result.stdout.splitlines()
+        if l.startswith('{"metric": "training.abort"')
+    ]
+    assert len(abort_records) == 1
+    assert abort_records[0]["reason"] == "round_deadline"
+    # rounds 0-2 completed their checkpoint saves before the wedge
+    ckpts = sorted(os.listdir(ckpt))
+    assert "xgboost-checkpoint.2" in ckpts, ckpts
+    assert not [f for f in ckpts if f.endswith(".sagemaker-ignore")]
+
+    # restart (platform behavior on non-zero exit): no fault this time
+    env2, model_dir = _sm_env(tmp_path, HPS, data, checkpoint_dir=ckpt)
+    result2 = _run_train(env2)
+    assert result2.returncode == 0, result2.stderr[-3000:]
+    eval_lines = [
+        l for l in result2.stdout.splitlines() if l.startswith("[") and "\t" in l
+    ]
+    # resumed at iteration 3 — NOT retrained from round 0
+    assert eval_lines and eval_lines[0].startswith("[3]"), eval_lines[:3]
+    assert (model_dir / "xgboost-model").exists()
+
+
+def test_sigterm_mid_training_leaves_fresh_loadable_model(tmp_path):
+    """Spot-interruption drill: SIGTERM during round 3 -> the intermediate
+    model in model_dir is the round-2 model, loadable, and the process
+    exits 0 (the reference's save_model_on_termination contract)."""
+    data = tmp_path / "data"
+    _write_csv(str(data), n=200)
+    hps = dict(HPS, save_model_on_termination="true")
+    env, model_dir = _sm_env(
+        tmp_path,
+        hps,
+        data,
+        extra={"SM_FAULT_SPEC": "training.round_end:sigterm@3"},
+    )
+    result = _run_train(env)
+    assert result.returncode == 0, (result.returncode, result.stderr[-2000:])
+    model_path = model_dir / "xgboost-model"
+    assert model_path.exists(), "SIGTERM must leave the intermediate model"
+    from sagemaker_xgboost_container_tpu.models import Forest
+
+    forest = Forest.load_model(str(model_path))
+    # fresh: saved after round 2 (3 rounds boosted), well short of num_round
+    assert forest.num_boosted_rounds == 3
+
+
+def test_checkpoint_resume_honors_remaining_rounds(tmp_path):
+    """In-process round trip: train 5 rounds with checkpoints, re-assemble
+    callbacks with num_round=8 -> resume trains exactly 8-5 more rounds."""
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.training.callbacks import get_callbacks
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(200, 3).astype(np.float32)
+    y = (3 * X[:, 0] + X[:, 1]).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    ckpt = str(tmp_path / "ckpt")
+    params = {"eta": "0.3", "max_depth": 2, "objective": "reg:squarederror"}
+
+    def _assemble(num_round):
+        return get_callbacks(
+            model_dir=str(tmp_path / "model"),
+            checkpoint_dir=ckpt,
+            early_stopping_data_name=None,
+            early_stopping_metric=None,
+            early_stopping_rounds=None,
+            save_model_on_termination="false",
+            is_master=True,
+            num_round=num_round,
+            num_rows=dtrain.num_row,
+        )
+
+    xgb_model, iteration, callbacks = _assemble(5)
+    assert xgb_model is None and iteration == 0
+    train(params, dtrain, num_boost_round=5 - iteration, callbacks=callbacks)
+    assert os.path.exists(os.path.join(ckpt, "xgboost-checkpoint.4"))
+
+    xgb_model, iteration, callbacks = _assemble(8)
+    assert xgb_model.endswith("xgboost-checkpoint.4") and iteration == 5
+    forest = train(
+        params,
+        dtrain,
+        num_boost_round=8 - iteration,
+        callbacks=callbacks,
+        xgb_model=xgb_model,
+    )
+    assert forest.num_boosted_rounds == 8
+    assert os.path.exists(os.path.join(ckpt, "xgboost-checkpoint.7"))
+
+
+# ----------------------------------------------------------------- CI lint
+
+
+def test_no_bare_except_gate_runs_clean_on_package():
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_no_bare_except.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+
+
+def test_no_bare_except_gate_flags_violations(tmp_path):
+    pkg = tmp_path / "sagemaker_xgboost_container_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "try:\n    pass\nexcept:\n    pass\n"
+    )
+    result = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "check_no_bare_except.py"),
+            str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 1
+    assert "bad.py:3" in result.stderr

@@ -48,6 +48,7 @@ _CONFIG_KEYS = (
     "GRAFT_HIST_VNODES",
     "GRAFT_ROUTE_IMPL",
     "GRAFT_TOTALS_IMPL",
+    "GRAFT_HIST_COMM",
 )
 
 
@@ -93,10 +94,13 @@ def _run_child(env_extra, timeout):
 def _backend_healthy(timeout):
     """Cheap pre-check: can the accelerator backend answer a tiny matmul
     within `timeout`? A wedged tunnel hangs jax.devices() forever — pay 90s
-    here instead of a full probe budget per config."""
+    here instead of a full probe budget per config.
+
+    Returns ``(healthy, n_devices)`` — the device count decides whether the
+    GRAFT_HIST_COMM probe column is meaningful (collectives need a mesh)."""
     code = (
         "import jax, jax.numpy as j;"
-        "print(jax.devices());"
+        "print('DEVICES', len(jax.devices()));"
         "print(float((j.ones((128,128))@j.ones((128,128))).sum()))"
     )
     try:
@@ -106,9 +110,13 @@ def _backend_healthy(timeout):
             text=True,
             timeout=timeout,
         )
-        return r.returncode == 0
+        n_devices = 1
+        for line in r.stdout.splitlines():
+            if line.startswith("DEVICES "):
+                n_devices = int(line.split()[1])
+        return r.returncode == 0, n_devices
     except subprocess.TimeoutExpired:
-        return False
+        return False, 0
 
 
 def _code_fingerprint():
@@ -207,7 +215,7 @@ def _cpu_fallback(deadline, note):
     return False
 
 
-def _probe_matrix(deadline):
+def _probe_matrix(deadline, n_devices=1):
     """A/B the histogram impls, each in its own supervised child. Returns
     (best_label, best_env, best_value, results, note)."""
     probe_timeout = int(os.getenv("BENCH_PROBE_TIMEOUT_S", "600"))
@@ -221,6 +229,7 @@ def _probe_matrix(deadline):
         "GRAFT_HIST_VNODES": "1",
         "GRAFT_ROUTE_IMPL": "gather",
         "GRAFT_TOTALS_IMPL": "segment",
+        "GRAFT_HIST_COMM": "psum",
     }
     configs = [
         ("flat", dict(base, GRAFT_HIST_IMPL="flat")),
@@ -247,6 +256,20 @@ def _probe_matrix(deadline):
             dict(base, GRAFT_HIST_IMPL="pallas", GRAFT_TOTALS_IMPL="onehot"),
         ),
     ]
+    if n_devices > 1 and os.getenv("BENCH_MESH", "1") != "0":
+        # the comm column is only meaningful on a mesh (the child builds one
+        # over all local devices — see main(); BENCH_MESH=0 disables the
+        # mesh, which would silently resolve this probe back to psum and
+        # burn a probe budget re-measuring the pallas baseline);
+        # reduce_scatter is the A/B candidate against the psum baseline
+        # pinned in every other entry
+        configs.append(
+            (
+                "pallas,comm=reduce_scatter",
+                dict(base, GRAFT_HIST_IMPL="pallas",
+                     GRAFT_HIST_COMM="reduce_scatter"),
+            )
+        )
     note = "no probe succeeded"
     best_label, best_env, best_value = None, None, -1.0
     results = {}
@@ -302,6 +325,7 @@ def _probe_matrix(deadline):
             ("pallas,vnodes=0", "GRAFT_HIST_VNODES", "0"),
             ("pallas,prec=bf16", "GRAFT_HIST_MM_PREC", "bf16"),
             ("pallas,route=onehot", "GRAFT_ROUTE_IMPL", "onehot"),
+            ("pallas,comm=reduce_scatter", "GRAFT_HIST_COMM", "reduce_scatter"),
         ]:
             if results.get(label, 0.0) > base_v * 1.03:
                 composed[key] = val
@@ -350,9 +374,11 @@ def _supervised_main():
     deadline = time.monotonic() + BENCH_TIMEOUT_S
 
     want_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+    n_devices = 1
     if not want_cpu:
         precheck_budget = int(os.getenv("BENCH_PRECHECK_TIMEOUT_S", "90"))
-        if not _backend_healthy(precheck_budget):
+        healthy, n_devices = _backend_healthy(precheck_budget)
+        if not healthy:
             sys.stderr.write(
                 "backend pre-check failed within {}s (wedged tunnel?)\n".format(
                     precheck_budget
@@ -401,7 +427,7 @@ def _supervised_main():
                 results,
                 config_map,
                 note,
-            ) = _probe_matrix(deadline)
+            ) = _probe_matrix(deadline, n_devices)
 
     remaining = deadline - time.monotonic()
     if best_label is not None and remaining >= 10:
@@ -429,7 +455,7 @@ def _supervised_main():
                     results,
                     config_map,
                     note,
-                ) = _probe_matrix(deadline)
+                ) = _probe_matrix(deadline, n_devices)
                 if best_label is not None and best_value > 0:
                     done, err = _measure_config(
                         best_label, best_env, deadline, 120,
@@ -591,7 +617,21 @@ def main():
         num_feature=dtrain.num_col,
         num_class=config.num_class,
     )
-    session = _TrainingSession(config, dtrain, [], forest)
+    # multi-device hosts measure the full data-parallel round (rows sharded
+    # over all local devices, GRAFT_HIST_COMM selecting the histogram
+    # collective) — the north-star is a v5p MESH rate, not a single chip.
+    # BENCH_MESH=0 opts out; single-device runs (incl. the CPU fallback,
+    # which never sets xla_force_host_platform_device_count) are unchanged.
+    mesh = None
+    mesh_note = ""
+    if os.getenv("BENCH_MESH", "1") != "0" and len(jax.devices()) > 1:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+        mesh_note = ", mesh={}xdata comm={}".format(
+            len(jax.devices()), os.getenv("GRAFT_HIST_COMM", "psum")
+        )
+    session = _TrainingSession(config, dtrain, [], forest, mesh=mesh)
 
     # the round-latency distribution rides the same telemetry registry the
     # trainer uses (training_round_seconds / training_phase_seconds), so the
@@ -640,8 +680,9 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, {}, {}){}".format(
-                    N_ROWS, N_FEATURES, shape_note, params["objective"], backend_note
+                "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, {}, {}{}){}".format(
+                    N_ROWS, N_FEATURES, shape_note, params["objective"],
+                    mesh_note, backend_note
                 ),
                 "value": round(rounds_per_sec, 3),
                 "unit": "rounds/sec",

@@ -22,6 +22,7 @@ Design choices:
   adjacent midpoint), matching `exact`-method fidelity on small data.
 """
 
+import functools
 import os
 
 import numpy as np
@@ -99,35 +100,25 @@ def _sketch_impl():
     return v
 
 
-def _device_cut_points(features, w, max_cuts):
-    """compute_cut_points's selection semantics as one vmapped XLA program.
+@functools.lru_cache(maxsize=32)
+def _cut_points_kernel(max_cuts, L):
+    """Jitted device-sketch kernel, cached per (max_cuts, L).
 
-    Mirrors the _select_cuts ALGORITHM step for step: stable sort, cumulative
-    weight at each distinct value's run end, evenly spaced weighted-quantile
-    targets, left-searchsorted picks deduped, adjacent-rep midpoints;
-    all-distinct shortcut when a feature has <= max_cuts distinct values; one
-    cut above the value for single-valued columns; none for all-missing
-    columns. Static shapes: outputs padded to [d, max_cuts] + true counts.
+    Bounded: L tracks the dataset row count, so a long-lived process
+    sketching many differently-sized datasets would otherwise pin one
+    compiled executable per size forever; LRU eviction lets stale kernels
+    be collected while any single training job (constant shapes) still
+    always hits.
 
-    NOT bitwise-identical to the host path: cumulative weights accumulate in
-    f32 via XLA's tree-structured scan and the quantile targets are f32,
-    while the host path does a sequential numpy f32 cumsum against f64
-    targets — on large n a razor-edge target can shift a searchsorted pick
-    by one distinct value, moving one cut by one value-midpoint (below
-    binning resolution; quality parity tested in tests/test_device_sketch.py).
-    A training job uses one lowering throughout (GRAFT_SKETCH_IMPL resolves
-    once per sketch), so within-job determinism is unaffected; retraining
-    with the other lowering may produce slightly different (equally valid)
-    cuts. TPU has no native f64, so exact host parity would need a
-    compensated scan — not worth it for a one-bin boundary shift.
+    Hoisted out of _device_cut_points (ADVICE r5): a fresh-closure
+    ``@jax.jit`` per call created a new jit wrapper each time, so the approx
+    re-sketch — which calls this EVERY dispatch — paid a full retrace +
+    compile per boosting round. Cached here, repeated calls with the same
+    static config hit the jit cache (tests/test_device_sketch.py asserts no
+    recompile via ``_cache_size``).
     """
     import jax
     import jax.numpy as jnp
-
-    n, d = features.shape
-    # scatter buffers sized so distinct[:max_cuts] is well-defined even when
-    # the dataset has fewer rows than max_cuts (n=100, max_bin=256)
-    L = max(n, max_cuts)
 
     @jax.jit
     def kernel(feats, wv):
@@ -196,7 +187,41 @@ def _device_cut_points(features, w, max_cuts):
 
         return jax.vmap(one)(cols)
 
-    mids, counts = kernel(
+    return kernel
+
+
+def _device_cut_points(features, w, max_cuts):
+    """compute_cut_points's selection semantics as one vmapped XLA program.
+
+    Mirrors the _select_cuts ALGORITHM step for step: stable sort, cumulative
+    weight at each distinct value's run end, evenly spaced weighted-quantile
+    targets, left-searchsorted picks deduped, adjacent-rep midpoints;
+    all-distinct shortcut when a feature has <= max_cuts distinct values; one
+    cut above the value for single-valued columns; none for all-missing
+    columns. Static shapes: outputs padded to [d, max_cuts] + true counts.
+    The jitted kernel is cached per (max_cuts, L) in _cut_points_kernel so
+    the per-dispatch approx re-sketch reuses the compiled program.
+
+    NOT bitwise-identical to the host path: cumulative weights accumulate in
+    f32 via XLA's tree-structured scan and the quantile targets are f32,
+    while the host path does a sequential numpy f32 cumsum against f64
+    targets — on large n a razor-edge target can shift a searchsorted pick
+    by one distinct value, moving one cut by one value-midpoint (below
+    binning resolution; quality parity tested in tests/test_device_sketch.py).
+    A training job uses one lowering throughout (GRAFT_SKETCH_IMPL resolves
+    once per sketch), so within-job determinism is unaffected; retraining
+    with the other lowering may produce slightly different (equally valid)
+    cuts. TPU has no native f64, so exact host parity would need a
+    compensated scan — not worth it for a one-bin boundary shift.
+    """
+    import jax.numpy as jnp
+
+    n, d = features.shape
+    # scatter buffers sized so distinct[:max_cuts] is well-defined even when
+    # the dataset has fewer rows than max_cuts (n=100, max_bin=256)
+    L = max(n, max_cuts)
+
+    mids, counts = _cut_points_kernel(max_cuts, L)(
         jnp.asarray(features, jnp.float32), jnp.asarray(w, jnp.float32)
     )
     mids = np.asarray(mids, np.float32)
@@ -244,21 +269,13 @@ def apply_cut_points(features, cut_points, max_bin):
     return bins
 
 
-def _device_apply(features, cut_points, max_bin, dtype):
-    """apply_cut_points as one vmapped on-device searchsorted (the binning
-    stage's other host loop, ~5s for 1M x 28). Cuts pad to [d, L] with +inf
-    (finite values never land in the pad; +inf values clip to the feature's
-    true cut count, matching numpy searchsorted semantics)."""
+@functools.lru_cache(maxsize=None)
+def _apply_kernel(max_bin):
+    """Jitted bin-apply kernel, cached per max_bin (hoisted like
+    _cut_points_kernel — the approx re-sketch re-bins train + eval sets
+    every dispatch and must hit the jit cache, not recompile)."""
     import jax
     import jax.numpy as jnp
-
-    d = features.shape[1]
-    L = max(1, max((len(c) for c in cut_points), default=1))
-    padded = np.full((d, L), np.inf, np.float32)
-    counts = np.zeros(d, np.int32)
-    for f, c in enumerate(cut_points):
-        padded[f, : len(c)] = c
-        counts[f] = len(c)
 
     @jax.jit
     def kernel(feats, cuts, cnts):
@@ -270,7 +287,25 @@ def _device_apply(features, cut_points, max_bin, dtype):
 
         return jax.vmap(one)(cols, cuts, cnts).T
 
-    out = kernel(
+    return kernel
+
+
+def _device_apply(features, cut_points, max_bin, dtype):
+    """apply_cut_points as one vmapped on-device searchsorted (the binning
+    stage's other host loop, ~5s for 1M x 28). Cuts pad to [d, L] with +inf
+    (finite values never land in the pad; +inf values clip to the feature's
+    true cut count, matching numpy searchsorted semantics)."""
+    import jax.numpy as jnp
+
+    d = features.shape[1]
+    L = max(1, max((len(c) for c in cut_points), default=1))
+    padded = np.full((d, L), np.inf, np.float32)
+    counts = np.zeros(d, np.int32)
+    for f, c in enumerate(cut_points):
+        padded[f, : len(c)] = c
+        counts[f] = len(c)
+
+    out = _apply_kernel(max_bin)(
         jnp.asarray(features, jnp.float32),
         jnp.asarray(padded),
         jnp.asarray(counts),

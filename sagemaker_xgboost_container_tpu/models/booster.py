@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..data.binning import bin_matrix
+from ..ops.histogram import hist_comm_impl, padded_feature_width, round_comm_plan
 from ..ops.ranking import build_group_layout, lambdarank_grad_hess
 from ..ops.tree_build import (
     build_tree,
@@ -262,6 +263,24 @@ class _TrainingSession:
         self.n_data_shards = (
             int(mesh.shape["data"]) if mesh is not None else 1
         )
+        # data-axis histogram collective (GRAFT_HIST_COMM): resolved ONCE per
+        # session — the round program is traced against it, so flipping the
+        # env mid-job cannot desynchronize shards; a new train() call (new
+        # session, new round-fn closure, hence its own jit cache entry)
+        # picks up the new value.
+        self.hist_comm = hist_comm_impl() if mesh is not None else "psum"
+        if self.hist_comm == "reduce_scatter" and self.has_feature_axis:
+            # reduce_scatter re-shards the SPLIT SCAN over the data axis;
+            # with a feature axis the scan is already column-sharded and the
+            # two slicings would compose into a 2-D winner merge we don't
+            # implement — refuse loudly rather than silently mis-merge.
+            raise exc.UserError(
+                "GRAFT_HIST_COMM=reduce_scatter applies to the data axis "
+                "only and does not compose with a 'feature' mesh axis. On a "
+                "2-D (data x feature) mesh use GRAFT_HIST_COMM=psum (the "
+                "feature axis already shards the split scan), or drop the "
+                "feature axis to use reduce_scatter."
+            )
         # multi-host: every process holds its own row shard; device arrays are
         # assembled into global arrays over the whole mesh
         self.is_multiprocess = mesh is not None and jax.process_count() > 1
@@ -419,7 +438,7 @@ class _TrainingSession:
         # column padding: features pad to a multiple of the feature shards
         # with always-missing columns (zero cuts -> never split on)
         d_real = self.train_binned.num_col
-        d_pad = -(-d_real // self.n_feature_shards) * self.n_feature_shards
+        d_pad = padded_feature_width(d_real, self.n_feature_shards)
         self.d_pad = d_pad
 
         def _put(local_np, spec):
@@ -521,6 +540,20 @@ class _TrainingSession:
         self.rng = jax.random.PRNGKey(config.seed)
 
         self.rounds_per_dispatch = max(1, config.rounds_per_dispatch)
+        if self.approx_resketch and self.rounds_per_dispatch > 1:
+            # libxgboost's approx refreshes split candidates every ITERATION;
+            # a K-round dispatch would re-sketch only once per K rounds — a
+            # silent semantic weakening (ADVICE r5). Keep per-iteration
+            # semantics; GRAFT_APPROX_RESKETCH=0 restores batched dispatches
+            # (single global sketch, hist semantics). docs/MIGRATION.md.
+            logger.info(
+                "tree_method='approx' re-sketches candidates before every "
+                "boosting iteration; forcing _rounds_per_dispatch=%d -> 1 "
+                "(set GRAFT_APPROX_RESKETCH=0 to keep batched dispatches "
+                "with a single global sketch).",
+                self.rounds_per_dispatch,
+            )
+            self.rounds_per_dispatch = 1
         self.device_metric_fns = None
         # Device metrics decompose into psum-able partial stats
         # (device_metrics.py), so they work on any mesh: K-round batching
@@ -569,6 +602,14 @@ class _TrainingSession:
             monotone[: len(vals)] = vals
         self.monotone = jnp.asarray(monotone)
         self.has_monotone = bool(config.monotone_constraints)
+
+        # static per-round collective footprint (telemetry): the data-axis
+        # histogram collectives' shapes + wire bytes, derived from the same
+        # level/step structure the builders trace (docs/DESIGN.md
+        # §Communication has the formula)
+        self.hist_comm_plan, self.hist_comm_bytes_per_round = self._comm_plan()
+        self._hist_comm_ms = None  # lazily calibrated at the first dispatch
+        self._set_comm_round_fields()
 
         self._round_fn = self._make_round_fn()
         self._apply_fn = self._make_apply_fn()
@@ -626,6 +667,8 @@ class _TrainingSession:
             feature_axis_name=feature_axis,
             n_feature_shards=self.n_feature_shards,
             d_global=self.train_binned.num_col,
+            hist_comm=self.hist_comm,
+            n_data_shards=self.n_data_shards,
         )
         if cfg.grow_policy == "lossguide":
             from ..ops.lossguide import build_tree_lossguide
@@ -905,6 +948,147 @@ class _TrainingSession:
         )
         return jax.jit(mapped, donate_argnums=(2,))
 
+    # ----------------------------------------------------------- comm stats
+    def _comm_plan(self):
+        """(entries, wire bytes/round) of the data-axis histogram
+        collectives — ops.histogram.round_comm_plan fed with this session's
+        static build structure (grow policy, subtraction gating, trees per
+        round)."""
+        cfg = self.config
+        if self.mesh is None or self.n_data_shards <= 1:
+            return [], 0
+        # columns each data shard histograms (whole width unless a feature
+        # axis splits them; reduce_scatter never coexists with one)
+        d_local = self.d_pad // self.n_feature_shards
+        num_bins = self.train_binned.num_bins
+        # the builders gate subtraction on the FULL feature width under both
+        # comm lowerings (bit-identity contract) — mirror that here so the
+        # plan matches what actually traces
+        if cfg.grow_policy == "lossguide":
+            from ..ops.lossguide import _subtraction_enabled
+
+            subtract = _subtraction_enabled(cfg.max_leaves, d_local, num_bins)
+        else:
+            from ..ops.tree_build import _subtraction_enabled
+
+            subtract = _subtraction_enabled(cfg.max_depth, d_local, num_bins)
+        return round_comm_plan(
+            cfg.grow_policy,
+            cfg.max_depth,
+            cfg.max_leaves,
+            d_local,
+            num_bins,
+            self.n_data_shards,
+            self.hist_comm,
+            subtract,
+            trees_per_round=cfg.num_parallel_tree * max(self.num_group, 1),
+        )
+
+    def _set_comm_round_fields(self):
+        """Clear the comm keys from the per-round record at session start so
+        no session inherits a previous one's collectives (dart reuses this
+        session for staging but dispatches its own GSPMD loop; single-device
+        sessions have no collectives at all). The real values are published
+        by the first ``_note_comm_dispatch`` — i.e. only by sessions that
+        actually run the comm-lowered round program."""
+        from ..telemetry import set_round_fields
+
+        set_round_fields(hist_comm=None, hist_comm_bytes=None, hist_comm_ms=None)
+
+    def _calibrate_hist_comm_ms(self):
+        """Isolated latency of one round's data-axis collectives, in ms.
+
+        The round program fuses collectives with compute, so their share of
+        round time is not observable host-side; instead each DISTINCT
+        payload shape in the comm plan is timed as a standalone jitted
+        collective on zeros (min of 3 reps after a warmup) and the per-round
+        estimate is the count-weighted sum. An isolated-latency estimate:
+        real rounds may overlap collectives with compute, so this is an
+        upper bound on the comm share. Returns 0.0 when calibration is
+        disabled (GRAFT_HIST_COMM_CALIBRATE=0) or fails.
+        """
+        if not self.hist_comm_plan:
+            return 0.0
+        if os.environ.get("GRAFT_HIST_COMM_CALIBRATE", "1") != "1":
+            return 0.0
+        import time
+
+        def psum_fn(x):
+            return jax.lax.psum(x, "data")
+
+        def scatter_fn(x):
+            return jax.lax.psum_scatter(
+                x, "data", scatter_dimension=1, tiled=True
+            )
+
+        try:
+            total_s = 0.0
+            timed = {}
+            for entry in self.hist_comm_plan:
+                key = (entry["kind"], entry["shape"])
+                if key not in timed:
+                    if (
+                        entry["kind"] == "hist"
+                        and self.hist_comm == "reduce_scatter"
+                    ):
+                        fn, out_spec = scatter_fn, P(None, "data", None)
+                    else:
+                        fn, out_spec = psum_fn, P()
+                    mapped = jax.jit(
+                        shard_map(
+                            fn,
+                            mesh=self.mesh,
+                            in_specs=(P(),),
+                            out_specs=out_spec,
+                            **_SHARD_MAP_REP_KW,
+                        )
+                    )
+                    x = jnp.zeros(entry["shape"], jnp.float32)
+                    jax.block_until_ready(mapped(x))  # compile + warm
+                    best = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(mapped(x))
+                        best = min(best, time.perf_counter() - t0)
+                    timed[key] = best
+                # one timing covers one tensor; the round moves G and H
+                total_s += timed[key] * 2 * entry["count"]
+            return total_s * 1000.0
+        except Exception as e:  # calibration must never break training
+            logger.warning("hist comm calibration failed: %s", e)
+            return 0.0
+
+    def _note_comm_dispatch(self, k_rounds):
+        """Fold one dispatch (k_rounds boosting rounds) into the comm
+        telemetry: hist_comm_bytes_total counter + (lazily) the calibrated
+        hist_comm_ms gauge and round-record field."""
+        if not self.hist_comm_plan:
+            return
+        from ..telemetry import REGISTRY, set_round_fields
+
+        labels = {"impl": self.hist_comm}
+        set_round_fields(
+            hist_comm=self.hist_comm,
+            hist_comm_bytes=self.hist_comm_bytes_per_round,
+        )
+        if self._hist_comm_ms is None:
+            self._hist_comm_ms = self._calibrate_hist_comm_ms()
+            if self._hist_comm_ms:
+                REGISTRY.gauge(
+                    "hist_comm_ms",
+                    "Calibrated isolated latency of one round's data-axis "
+                    "histogram collectives (upper bound: real rounds may "
+                    "overlap them with compute)",
+                    labels,
+                ).set(round(self._hist_comm_ms, 3))
+                set_round_fields(hist_comm_ms=round(self._hist_comm_ms, 3))
+        REGISTRY.counter(
+            "hist_comm_bytes_total",
+            "Estimated cross-shard wire bytes moved by histogram "
+            "collectives (ring formula, docs/DESIGN.md Communication)",
+            labels,
+        ).inc(self.hist_comm_bytes_per_round * k_rounds)
+
     # ------------------------------------------------------------- resketch
     def _stage_train_bins(self, raw_bins, cuts, max_bin):
         """Stage [n_local, d_real] bin indices + per-feature cuts as the
@@ -1044,6 +1228,7 @@ class _TrainingSession:
                     self.eval_margins[i] = self._apply_fn(
                         packed, self.eval_bins[i], self.eval_margins[i]
                     )
+            self._note_comm_dispatch(1)
             return [unpack_tree(np.asarray(packed))], None
         eval_m = tuple(m for m in self.eval_margins if m is not None)
         eval_blw = tuple(
@@ -1060,6 +1245,7 @@ class _TrainingSession:
                 self.eval_margins[i] = eval_m_out[ei]
                 ei += 1
         packed_np = np.asarray(packed)  # ONE transfer for K rounds
+        self._note_comm_dispatch(packed_np.shape[0])
         metrics_np = np.asarray(metrics) if self.device_metric_fns else None
         return (
             [unpack_tree(packed_np[j]) for j in range(packed_np.shape[0])],

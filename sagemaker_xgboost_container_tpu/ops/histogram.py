@@ -69,6 +69,125 @@ def _matmul_precision():
     return os.environ.get("GRAFT_HIST_MM_PREC", "bf16x2")
 
 
+def hist_comm_impl():
+    """Cross-shard histogram collective for the data axis (GRAFT_HIST_COMM).
+
+    * ``psum`` (default): allreduce the full [W, d, B] grad+hess histograms
+      to every device; every device then runs the identical split scan.
+    * ``reduce_scatter``: ``lax.psum_scatter`` along the data axis — each
+      device receives the globally summed histograms for only its
+      d/axis_size feature slice and scans just that slice; winners merge
+      across shards afterwards (LightGBM's reduce-scatter histogram
+      aggregation, Ke et al. 2017, transplanted onto the SPMD round).
+      Roughly halves collective wire bytes (ring allreduce moves
+      2(p-1)/p x payload, reduce-scatter (p-1)/p) and divides split-scan
+      FLOPs by the axis size.
+    """
+    v = os.environ.get("GRAFT_HIST_COMM", "psum")
+    if v not in ("psum", "reduce_scatter"):
+        raise ValueError(
+            "Unknown GRAFT_HIST_COMM=%r; expected psum|reduce_scatter" % v
+        )
+    return v
+
+
+def padded_feature_width(d, axis_size):
+    """Features padded up to a multiple of the data-axis size so the
+    reduce-scatter slice boundary is static and every shard owns an equal
+    contiguous column slice. The padded columns carry all-zero histograms
+    and zero cut counts, so they can never win a split."""
+    return -(-d // axis_size) * axis_size
+
+
+def scatter_histograms(G, H, axis_name, axis_size):
+    """psum_scatter (G, H) [W, d, B] along the feature dim of the data axis.
+
+    Returns ([W, d_pad/axis_size, B], same) — the globally summed histograms
+    for this shard's contiguous feature slice. Values are the same sums the
+    full psum would produce for those columns (XLA reduces both collectives
+    in rank order), so split decisions downstream stay bit-identical.
+    """
+    d = G.shape[1]
+    d_pad = padded_feature_width(d, axis_size)
+    if d_pad != d:
+        pad = [(0, 0), (0, d_pad - d), (0, 0)]
+        G = jnp.pad(G, pad)
+        H = jnp.pad(H, pad)
+    G = jax.lax.psum_scatter(G, axis_name, scatter_dimension=1, tiled=True)
+    H = jax.lax.psum_scatter(H, axis_name, scatter_dimension=1, tiled=True)
+    return G, H
+
+
+def _wire_ratio(comm, axis_size):
+    """Per-device wire bytes per logical payload byte for a ring collective:
+    allreduce = reduce-scatter + all-gather = 2(p-1)/p; reduce-scatter alone
+    = (p-1)/p. The bytes-per-round formula in docs/DESIGN.md §Communication
+    is this ratio times the payload size."""
+    p = axis_size
+    if p <= 1:
+        return 0.0
+    frac = (p - 1) / p
+    return 2.0 * frac if comm == "psum" else frac
+
+
+def round_comm_plan(
+    grow_policy,
+    max_depth,
+    max_leaves,
+    d,
+    num_bins,
+    axis_size,
+    comm,
+    subtract,
+    trees_per_round=1,
+):
+    """Static per-round collective plan for the data axis.
+
+    Returns ``(entries, bytes_per_round)`` where each entry is
+    ``{"kind": "hist"|"totals", "shape": local payload shape, "count": n,
+    "bytes": wire bytes for all n collectives}``. ``bytes_per_round`` feeds
+    the ``hist_comm_bytes_total`` counter; the entry list feeds the
+    latency calibration (one timing per distinct shape). Payload = G and H
+    f32 tensors; wire bytes = payload x ring ratio (_wire_ratio).
+    """
+    if axis_size <= 1:
+        return [], 0
+    d_eff = padded_feature_width(d, axis_size) if comm == "reduce_scatter" else d
+    ratio = _wire_ratio(comm, axis_size)
+    psum_ratio = _wire_ratio("psum", axis_size)
+    hist_widths = []
+    totals = []
+    if grow_policy == "lossguide":
+        hist_widths.append((1, 1))                       # root
+        if max_leaves > 1:
+            w = 1 if subtract else 2
+            hist_widths.append((w, max_leaves - 1))      # per split step
+    else:
+        hist_widths.append((1, 1))                       # level 0
+        for level in range(1, max_depth):
+            hist_widths.append((2 ** (level - 1) if subtract else 2**level, 1))
+        totals.append((2**max_depth, 1))                 # last-level node totals
+    entries = []
+    total_bytes = 0.0
+    for W, count in hist_widths:
+        count *= trees_per_round
+        payload = 2 * W * d_eff * num_bins * 4           # G + H, f32
+        b = payload * ratio * count
+        entries.append(
+            {"kind": "hist", "shape": (W, d_eff, num_bins), "count": count,
+             "bytes": b}
+        )
+        total_bytes += b
+    for W, count in totals:
+        count *= trees_per_round
+        b = 2 * W * 4 * psum_ratio * count               # totals always psum
+        entries.append(
+            {"kind": "totals", "shape": (W,), "count": count, "bytes": b}
+        )
+        total_bytes += b
+    return entries, int(total_bytes)
+
+
 def subtraction_enabled(cache_bytes):
     """Shared gate for sibling-subtraction paths (both growers): the
     GRAFT_HIST_SUBTRACT kill-switch plus a memory cap on the histogram cache
@@ -79,7 +198,17 @@ def subtraction_enabled(cache_bytes):
     return cache_bytes <= cap
 
 
-def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name=None):
+def level_histogram(
+    bins,
+    grad,
+    hess,
+    node_local,
+    num_nodes,
+    num_bins,
+    axis_name=None,
+    comm="psum",
+    axis_size=1,
+):
     """Build (G, H) histograms for one tree level.
 
     Args:
@@ -90,9 +219,14 @@ def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name
       num_nodes: static int — number of nodes at this level (2**level).
       num_bins: static int — histogram width per feature (max_bin + 1).
       axis_name: mesh axis to psum over, or None on a single device.
+      comm: cross-shard lowering (hist_comm_impl): "psum" allreduces the
+        full histograms; "reduce_scatter" psum_scatters them along the
+        feature dim so each shard gets only its d/axis_size column slice.
+      axis_size: static size of ``axis_name`` (required for reduce_scatter).
 
     Returns:
-      (G, H): f32 [num_nodes, d, num_bins].
+      (G, H): f32 [num_nodes, d, num_bins] for psum / no axis;
+      f32 [num_nodes, padded_d/axis_size, num_bins] for reduce_scatter.
     """
     impl = _impl()
     if impl == "per_feature":
@@ -109,8 +243,11 @@ def level_histogram(bins, grad, hess, node_local, num_nodes, num_bins, axis_name
             % impl
         )
     if axis_name is not None:
-        G = jax.lax.psum(G, axis_name)
-        H = jax.lax.psum(H, axis_name)
+        if comm == "reduce_scatter":
+            G, H = scatter_histograms(G, H, axis_name, axis_size)
+        else:
+            G = jax.lax.psum(G, axis_name)
+            H = jax.lax.psum(H, axis_name)
     return G, H
 
 

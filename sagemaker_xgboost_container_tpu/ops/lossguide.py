@@ -20,23 +20,29 @@ inherent to static-shape leaf-wise growth without dynamic row partitions.
 import jax
 import jax.numpy as jnp
 
-from .histogram import level_histogram, subtraction_enabled
+from .histogram import level_histogram, padded_feature_width, subtraction_enabled
 from .split import (
+    broadcast_node_totals,
     column_shard_helpers,
     combine_splits_across_shards,
     find_best_splits,
     leaf_weight,
+    shard_feature_slice,
 )
 
 MIN_SPLIT_LOSS = 1e-6
 
 
-def _subtraction_enabled(max_leaves, d, num_bins):
+def _subtraction_enabled(max_leaves, d_hist, num_bins):
     """Sibling subtraction for leaf-wise growth: every split step histograms
     only the LEFT fresh child (W=1 scan over rows) and derives the right one
     from the parent's cached histogram — halving per-step histogram work.
-    Needs a [2*max_leaves-1, d, B] f32 cache x2, so gated by the shared cap."""
-    return subtraction_enabled(2 * (2 * max_leaves - 1) * d * num_bins * 4)
+    Needs a [2*max_leaves-1, d_hist, B] f32 cache x2, so gated by the shared
+    cap. Callers pass the FULL feature width regardless of the
+    GRAFT_HIST_COMM lowering (same-decision-both-lowerings bit-identity
+    contract — see ops.tree_build._subtraction_enabled); under
+    reduce_scatter the resident cache is only the d/axis_size slice."""
+    return subtraction_enabled(2 * (2 * max_leaves - 1) * d_hist * num_bins * 4)
 
 
 def build_tree_lossguide(
@@ -63,15 +69,35 @@ def build_tree_lossguide(
     feature_axis_name=None,
     n_feature_shards=1,
     d_global=None,
+    hist_comm="psum",
+    n_data_shards=1,
 ):
     """Grow one leaf-wise tree. Returns (tree arrays dict, row_out [n]).
 
     Same output layout as ops.tree_build.build_tree; max_depth=0 means
-    unbounded depth (bounded by max_leaves - 1).
+    unbounded depth (bounded by max_leaves - 1). ``hist_comm`` selects the
+    data-axis collective (see ops.tree_build.build_tree): reduce_scatter
+    scans only this shard's feature slice per step and merges winners into
+    the candidate store with bit-identical tie-breaking.
     """
     n, d = bins.shape
     max_nodes = 2 * max_leaves - 1
     depth_cap = max_depth if max_depth > 0 else max_leaves
+    reduce_scatter = hist_comm == "reduce_scatter" and axis_name is not None
+    if reduce_scatter and feature_axis_name is not None:
+        raise ValueError(
+            "GRAFT_HIST_COMM=reduce_scatter shards the split scan over the "
+            "data axis and cannot compose with a 'feature' mesh axis; use "
+            "GRAFT_HIST_COMM=psum on 2-D (data x feature) meshes."
+        )
+    d_scan = padded_feature_width(d, n_data_shards) // n_data_shards if reduce_scatter else d
+    data_shard = jax.lax.axis_index(axis_name) if reduce_scatter else None
+
+    def _scan_slice(arr):
+        """Per-feature scan input -> this shard's slice (reduce_scatter)."""
+        if not reduce_scatter or arr is None:
+            return arr
+        return shard_feature_slice(arr, data_shard, d_scan, n_data_shards)
 
     # feature-axis sharding: this shard holds columns [feat_shard*d,
     # (feat_shard+1)*d) of the global matrix; candidate splits are combined
@@ -88,9 +114,22 @@ def build_tree_lossguide(
     )
 
     def _combine(splits):
+        if reduce_scatter:
+            # data-axis winner merge (shared with the feature-axis path);
+            # totals were broadcast from shard 0 before the scan
+            return combine_splits_across_shards(
+                splits, data_shard, d_scan, axis_name
+            )
         if feature_axis_name is None:
             return splits
         return combine_splits_across_shards(splits, feat_shard, d, feature_axis_name)
+
+    def _scan_totals(G, H):
+        """Pre-scan node totals under reduce_scatter (bit-identical to the
+        psum lowering's feature-0 derivation); None otherwise."""
+        if not reduce_scatter:
+            return None
+        return broadcast_node_totals(G, H, data_shard, axis_name)
 
     # colsample_bylevel: one Bernoulli feature mask per DEPTH, shared by all
     # nodes at that depth (the leaf-wise analog of tree_build's per-level
@@ -170,18 +209,19 @@ def build_tree_lossguide(
         else:
             G, H = level_histogram(
                 bins, grad, hess, parent_rows_mask_nodes, 2, num_bins,
-                axis_name=axis_name,
+                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
             )
         splits = find_best_splits(
             G,
             H,
-            num_cuts,
+            _scan_slice(num_cuts),
             reg_lambda=reg_lambda,
             alpha=alpha,
             gamma=gamma,
             min_child_weight=min_child_weight,
-            feature_mask=mask if mask is not None else feature_mask,
-            monotone=monotone,
+            feature_mask=_scan_slice(mask if mask is not None else feature_mask),
+            monotone=_scan_slice(monotone),
+            totals=_scan_totals(G, H),
         )
         # cross-shard combine: the candidate store (and therefore every
         # step's argmax) must be identical on all shards, with GLOBAL ids
@@ -191,15 +231,20 @@ def build_tree_lossguide(
         gains = jnp.where(can_deepen, splits["gain"], -jnp.inf)
         return splits, gains
 
+    # full-width gate under both lowerings (bit-identity: same build path)
     subtract = _subtraction_enabled(max_leaves, d, num_bins)
     if subtract:
-        # per-node histogram cache (filled as leaves are created)
-        hist_G = jnp.zeros((max_nodes, d, num_bins), jnp.float32)
-        hist_H = jnp.zeros((max_nodes, d, num_bins), jnp.float32)
+        # per-node histogram cache (filled as leaves are created); stores
+        # only this shard's feature slice under reduce_scatter
+        hist_G = jnp.zeros((max_nodes, d_scan, num_bins), jnp.float32)
+        hist_H = jnp.zeros((max_nodes, d_scan, num_bins), jnp.float32)
 
     # root candidate
     root_local = jnp.zeros(n, jnp.int32)
-    G, H = level_histogram(bins, grad, hess, root_local, 1, num_bins, axis_name=axis_name)
+    G, H = level_histogram(
+        bins, grad, hess, root_local, 1, num_bins,
+        axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
+    )
     if subtract:
         hist_G = hist_G.at[0].set(G[0])
         hist_H = hist_H.at[0].set(H[0])
@@ -208,11 +253,12 @@ def build_tree_lossguide(
         allowed0 = _allowed_cols(alive_sets[0])
         root_mask = allowed0 if root_mask is None else root_mask * allowed0
     root_splits = find_best_splits(
-        G, H, num_cuts,
+        G, H, _scan_slice(num_cuts),
         reg_lambda=reg_lambda, alpha=alpha, gamma=gamma,
         min_child_weight=min_child_weight,
-        feature_mask=root_mask,
-        monotone=monotone,
+        feature_mask=_scan_slice(root_mask),
+        monotone=_scan_slice(monotone),
+        totals=_scan_totals(G, H),
     )
     root_splits = _combine(root_splits)
     cand["gain"] = cand["gain"].at[0].set(root_splits["gain"][0])
@@ -316,7 +362,8 @@ def build_tree_lossguide(
             # zeros and the right side is forced to zero too.
             left_local = jnp.where(can & (node_of_row == id_a), 0, -1)
             Ga, Ha = level_histogram(
-                bins, grad, hess, left_local, 1, num_bins, axis_name=axis_name
+                bins, grad, hess, left_local, 1, num_bins,
+                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
             )
             Gb = jnp.where(can, hist_G[l] - Ga[0], 0.0)
             Hb = jnp.where(can, hist_H[l] - Ha[0], 0.0)

@@ -19,19 +19,30 @@ _EPS = 1e-6  # xgboost kRtEps: minimum loss change to accept a split
 
 
 def combine_splits_across_shards(splits, feat_shard, d_local, feature_axis_name):
-    """Merge per-shard best splits along a *feature* mesh axis.
+    """Merge per-shard best splits along a mesh axis carrying feature slices.
 
     Each column shard proposes its best (gain, local feature, bin,
     default_left) per node; the winner is the max gain with ties broken
     toward the lowest global feature id (matching the single-device argmax
     over the concatenated column order), and the winning shard's bin /
     default_left are psum-broadcast so every shard ends with identical
-    global split decisions. ``g_total``/``h_total`` are already identical
-    on every shard (every row lands in exactly one bin of every feature).
+    global split decisions.
+
+    Two callers share this merge:
+
+    * the *feature* mesh axis (column-sharded data — the reference's
+      vestigial dsplit=col done as SPMD). ``g_total``/``h_total`` are
+      already identical on every shard (every row lands in exactly one bin
+      of every feature), so they pass through (``select_totals=False``).
+    * the *data* axis under ``GRAFT_HIST_COMM=reduce_scatter``
+      (ops/histogram.scatter_histograms): every shard holds all columns but
+      scanned only its psum_scattered feature slice. Its node totals must
+      come through ``broadcast_node_totals`` BEFORE the scan (every shard's
+      gains then use the identical totals), after which the passthrough
+      here is exact on every shard.
 
     Used by both the depthwise (ops/tree_build.py) and leaf-wise
-    (ops/lossguide.py) builders — the reference's vestigial dsplit=col
-    (hyperparameter_validation.py:256) done as SPMD.
+    (ops/lossguide.py) builders.
     """
     global_feat = splits["feature"] + feat_shard * d_local
     gain = splits["gain"]
@@ -54,6 +65,44 @@ def combine_splits_across_shards(splits, feat_shard, d_local, feature_axis_name)
         "g_total": splits["g_total"],
         "h_total": splits["h_total"],
     }
+
+
+def broadcast_node_totals(G, H, shard, axis_name):
+    """Per-node (sum g, sum h) for the reduce_scatter lowering.
+
+    The psum lowering derives node totals inside the scan as "sum over the
+    bins of feature 0" — every row lands in exactly one bin of every
+    feature, so any feature's bins sum to the node total *mathematically*,
+    but NOT bitwise (different values, different accumulation). Under
+    reduce_scatter each shard's slice starts at a different global feature,
+    so totals must come from the shard owning global feature 0 and
+    psum-broadcast (adding exact zeros) BEFORE the gain scan; every shard's
+    gains then use totals bit-identical to the psum lowering's.
+    """
+    own0 = shard == 0
+    g = jnp.where(own0, G[:, 0, :].sum(axis=-1), 0.0)
+    h = jnp.where(own0, H[:, 0, :].sum(axis=-1), 0.0)
+    return jax.lax.psum(g, axis_name), jax.lax.psum(h, axis_name)
+
+
+def shard_feature_slice(arr, shard, d_local, axis_size):
+    """This shard's contiguous feature slice of a per-feature array.
+
+    ``arr`` is [..., d] over the real feature width; it zero-pads to
+    ``d_local * axis_size`` (ops/histogram.padded_feature_width) and slices
+    ``[shard * d_local, (shard + 1) * d_local)``. Zero padding is inert for
+    every consumer: num_cuts 0 = no legal split bins, feature_mask 0 =
+    masked, monotone 0 = unconstrained. Companion of scatter_histograms —
+    the scan inputs must slice exactly like the scattered histograms.
+    """
+    d = arr.shape[-1]
+    d_pad = d_local * axis_size
+    if d_pad != d:
+        pad = [(0, 0)] * (arr.ndim - 1) + [(0, d_pad - d)]
+        arr = jnp.pad(arr, pad)
+    start = (0,) * (arr.ndim - 1) + (shard * d_local,)
+    sizes = arr.shape[:-1] + (d_local,)
+    return jax.lax.dynamic_slice(arr, start, sizes)
 
 
 def column_shard_helpers(feat_shard, d_local, n_feature_shards, d_global):
@@ -109,6 +158,7 @@ def find_best_splits(
     min_child_weight=1.0,
     feature_mask=None,
     monotone=None,
+    totals=None,
 ):
     """Best (feature, bin, default_dir, gain) per node at one level.
 
@@ -119,15 +169,22 @@ def find_best_splits(
       feature_mask: optional f32/bool [d] colsample mask, or [W, d] per-node
         mask (interaction constraints); 1 = usable.
       monotone: optional i32 [d] in {-1, 0, 1} monotone constraints.
+      totals: optional (g_total, h_total) f32 [W] pair overriding the
+        feature-0 derivation — required when G/H are a reduce_scattered
+        feature slice (broadcast_node_totals), where local feature 0 is a
+        different global feature on every shard.
 
     Returns dict of per-node arrays (length W): gain f32, feature i32,
     bin i32, default_left bool, plus node totals g_total/h_total f32.
     """
     W, d, B = G.shape
     nbins = B - 1  # data bins
-    # node totals: every row lands in exactly one bin of feature 0
-    g_total = G[:, 0, :].sum(axis=-1)
-    h_total = H[:, 0, :].sum(axis=-1)
+    if totals is None:
+        # node totals: every row lands in exactly one bin of feature 0
+        g_total = G[:, 0, :].sum(axis=-1)
+        h_total = H[:, 0, :].sum(axis=-1)
+    else:
+        g_total, h_total = totals
 
     g_miss = G[:, :, nbins]  # [W, d]
     h_miss = H[:, :, nbins]

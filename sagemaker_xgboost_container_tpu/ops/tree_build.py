@@ -18,12 +18,19 @@ import os
 import jax
 import jax.numpy as jnp
 
-from .histogram import level_histogram, node_totals, subtraction_enabled
+from .histogram import (
+    level_histogram,
+    node_totals,
+    padded_feature_width,
+    subtraction_enabled,
+)
 from .split import (
+    broadcast_node_totals,
     column_shard_helpers,
     combine_splits_across_shards,
     find_best_splits,
     leaf_weight,
+    shard_feature_slice,
 )
 
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
@@ -52,14 +59,19 @@ def max_nodes_for_depth(max_depth):
     return 2 ** (max_depth + 1) - 1
 
 
-def _subtraction_enabled(max_depth, d, num_bins):
+def _subtraction_enabled(max_depth, d_hist, num_bins):
     """Histogram subtraction: build only left children, derive right ones as
     parent - left (libxgboost's standard sibling trick) — halves histogram
     work per level. Needs the previous level's histograms cached
-    ([2**(L-1), d, B] f32 x2); gated by the shared memory cap."""
+    ([2**(L-1), d_hist, B] f32 x2); gated by the shared memory cap.
+    Callers pass the FULL feature width for ``d_hist`` regardless of the
+    GRAFT_HIST_COMM lowering, so psum and reduce_scatter always make the
+    same subtraction decision and commit bit-identical trees; under
+    reduce_scatter the cache actually resident is only the d/axis_size
+    slice (1/axis_size of this estimate)."""
     if max_depth < 2:
         return False
-    return subtraction_enabled(2 * (2 ** (max_depth - 1)) * d * num_bins * 4)
+    return subtraction_enabled(2 * (2 ** (max_depth - 1)) * d_hist * num_bins * 4)
 
 
 def build_tree(
@@ -85,6 +97,8 @@ def build_tree(
     feature_axis_name=None,
     n_feature_shards=1,
     d_global=None,
+    hist_comm="psum",
+    n_data_shards=1,
 ):
     """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
 
@@ -99,8 +113,27 @@ def build_tree(
     max-gain, and row routing decisions (which need the winning feature's
     bins) are computed by the owning shard and psum-broadcast. Emitted
     feature ids are global.
+
+    hist_comm: data-axis collective lowering (ops/histogram.hist_comm_impl).
+    Under ``reduce_scatter`` each shard receives the globally summed
+    histograms for only its d/n_data_shards feature slice, scans that slice,
+    and the per-shard winners merge through the same
+    combine_splits_across_shards machinery the feature axis uses (the data
+    axis IS a feature axis for the duration of the split scan). Tie-breaking
+    (max gain, lowest global feature id) and node totals are bit-identical
+    to the psum lowering, so committed trees match bitwise.
     """
     n, d = bins.shape
+    reduce_scatter = hist_comm == "reduce_scatter" and axis_name is not None
+    if reduce_scatter and feature_axis_name is not None:
+        raise ValueError(
+            "GRAFT_HIST_COMM=reduce_scatter shards the split scan over the "
+            "data axis and cannot compose with a 'feature' mesh axis; use "
+            "GRAFT_HIST_COMM=psum on 2-D (data x feature) meshes."
+        )
+    # reduce_scatter: the scan runs on this shard's feature slice only
+    d_scan = padded_feature_width(d, n_data_shards) // n_data_shards if reduce_scatter else d
+    data_shard = jax.lax.axis_index(axis_name) if reduce_scatter else None
     max_nodes = max_nodes_for_depth(max_depth)
     # bins stay in their storage dtype (u8/u16 from binning) end to end:
     # every consumer widens inside a fused op, so no [n, d] i32 copy is ever
@@ -135,8 +168,14 @@ def build_tree(
         jax.lax.axis_index(feature_axis_name) if feature_axis_name is not None else None
     )
 
+    # the subtraction DECISION is gated on the full feature width under both
+    # lowerings so psum and reduce_scatter always take the same build path —
+    # a split gate (slice width under reduce_scatter) would let the two
+    # commit bitwise-divergent trees in the (cap/p, cap] window, breaking
+    # the bit-identity contract. The resident cache under reduce_scatter is
+    # still only the [W/2, d_scan, B] slice (1/p of the gate's estimate).
     subtract = _subtraction_enabled(max_depth, d, num_bins)
-    G_cache = H_cache = None      # previous level's [W/2, d, B] histograms
+    G_cache = H_cache = None      # previous level's [W/2, d_scan, B] histograms
     parent_leaf = None            # previous level's becomes_leaf [W/2]
 
     for level in range(max_depth + 1):
@@ -174,18 +213,19 @@ def build_tree(
             left_local = jnp.where(active & is_left, node_local // 2, -1)
             Gl, Hl = level_histogram(
                 bins, grad, hess, left_local, width // 2, num_bins,
-                axis_name=axis_name,
+                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
             )
             keep = ~parent_leaf
             Gp = jnp.where(keep[:, None, None], G_cache, 0.0)
             Hp = jnp.where(keep[:, None, None], H_cache, 0.0)
             Gr = Gp - Gl
             Hr = Hp - Hl
-            G = jnp.stack([Gl, Gr], axis=1).reshape(width, d, -1)
-            H = jnp.stack([Hl, Hr], axis=1).reshape(width, d, -1)
+            G = jnp.stack([Gl, Gr], axis=1).reshape(width, Gl.shape[1], -1)
+            H = jnp.stack([Hl, Hr], axis=1).reshape(width, Hl.shape[1], -1)
         else:
             G, H = level_histogram(
-                bins, grad, hess, node_local, width, num_bins, axis_name=axis_name
+                bins, grad, hess, node_local, width, num_bins,
+                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
             )
         if subtract:
             G_cache, H_cache = G, H
@@ -224,17 +264,42 @@ def build_tree(
             ) > 0
             per_node = _local_cols(node_allowed.astype(jnp.float32))
             level_mask = per_node if level_mask is None else per_node * level_mask[None, :]
+        scan_cuts, scan_mask, scan_mono, scan_totals = (
+            num_cuts, level_mask, monotone, None,
+        )
+        if reduce_scatter:
+            # the scan sees only this shard's globally-summed feature slice;
+            # its per-feature inputs must slice exactly like the histograms,
+            # and node totals broadcast from shard 0 BEFORE the scan so
+            # every shard's gains use bit-identical totals
+            scan_cuts = shard_feature_slice(num_cuts, data_shard, d_scan, n_data_shards)
+            if scan_mask is not None:
+                scan_mask = shard_feature_slice(
+                    scan_mask, data_shard, d_scan, n_data_shards
+                )
+            if scan_mono is not None:
+                scan_mono = shard_feature_slice(
+                    scan_mono, data_shard, d_scan, n_data_shards
+                )
+            scan_totals = broadcast_node_totals(G, H, data_shard, axis_name)
         splits = find_best_splits(
             G,
             H,
-            num_cuts,
+            scan_cuts,
             reg_lambda=reg_lambda,
             alpha=alpha,
             gamma=gamma,
             min_child_weight=min_child_weight,
-            feature_mask=level_mask,
-            monotone=monotone,
+            feature_mask=scan_mask,
+            monotone=scan_mono,
+            totals=scan_totals,
         )
+        if reduce_scatter:
+            # the data axis is a feature axis for the duration of the scan:
+            # the same winner merge (totals pass through — already broadcast)
+            splits = combine_splits_across_shards(
+                splits, data_shard, d_scan, axis_name
+            )
         if feature_axis_name is not None:
             splits = combine_splits_across_shards(
                 splits, feat_shard, d, feature_axis_name

@@ -52,6 +52,15 @@ class PredictBatcher:
     pure jitted kernel). ``max_batch_rows`` bounds padding waste;
     ``max_wait_ms`` bounds added latency under low load; ``max_queue``
     (None = unbounded) bounds in-flight requests, rejecting beyond it.
+
+    Ordering note: requests are NOT strictly FIFO under light concurrency.
+    The idle inline fast path runs small requests on the caller's thread
+    under a non-blocking exec lock, so a new request can execute ahead of
+    one the worker has already dequeued (held while parked on that lock).
+    The reordering is bounded to a single overtaken request and is harmless
+    for stateless prediction — but any future stateful use (sequence-
+    sensitive accounting, streaming sessions) must not assume arrival-order
+    execution.
     """
 
     def __init__(

@@ -32,7 +32,13 @@ def _sniff_delimiter(first_line):
     """csv.Sniffer costs ~0.4 ms per call — dominating single-row serve
     payloads — so the unambiguous cases (zero or exactly one candidate
     delimiter present) short-circuit it; only ambiguous lines (e.g. both
-    ',' and ' ' present) pay for the full Sniffer."""
+    ',' and ' ' present) pay for the full Sniffer.
+
+    The probe line is stripped first: a single-column payload with
+    incidental leading/trailing whitespace (``b"1.0 "``) must not sniff
+    ``' '`` and grow a phantom NaN column (ADVICE r5 — the reference's
+    always-sniff path never did)."""
+    first_line = first_line.strip()
     present = [c for c in _DELIM_CANDIDATES if c in first_line]
     if not present:
         return ","

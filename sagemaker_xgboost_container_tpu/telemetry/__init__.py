@@ -30,6 +30,8 @@ from .correlation import (  # noqa: F401
 from .emit import (  # noqa: F401
     STRUCTURED_METRICS_ENV,
     emit_metric,
+    get_round_fields,
+    set_round_fields,
     snapshot_fields,
     structured_enabled,
 )

@@ -21,6 +21,32 @@ STRUCTURED_METRICS_ENV = "SM_STRUCTURED_METRICS"
 
 _write_lock = threading.Lock()
 
+# Extra fields merged into every ``training.round`` record (see
+# profiling.RoundTimer). Set by the training session for facts only it
+# knows (e.g. the histogram-collective lowering and its per-round wire
+# bytes — GRAFT_HIST_COMM); process-wide like ROUND_STATE, last writer
+# wins, which matches sequential training sessions.
+_round_fields = {}
+_round_fields_lock = threading.Lock()
+
+
+def set_round_fields(**fields):
+    """Merge fields into the per-round record; a value of None removes
+    the key (so a later single-device session clears a mesh session's
+    comm fields instead of reporting them stale)."""
+    with _round_fields_lock:
+        for key, value in fields.items():
+            if value is None:
+                _round_fields.pop(key, None)
+            else:
+                _round_fields[key] = value
+
+
+def get_round_fields():
+    """Snapshot of the extra per-round fields (copy — safe to mutate)."""
+    with _round_fields_lock:
+        return dict(_round_fields)
+
 
 def structured_enabled():
     return env_bool(STRUCTURED_METRICS_ENV, True)

@@ -25,6 +25,7 @@ from ..telemetry import (
     REGISTRY,
     ROUND_STATE,
     emit_metric,
+    get_round_fields,
     pop_recorder,
     push_recorder,
 )
@@ -99,6 +100,9 @@ class RoundTimer:
                     "round_ms": round(elapsed * 1000, 3),
                     "phases_ms": phases_ms,
                 }
+                # session-owned extras (hist_comm lowering + per-round
+                # collective bytes/ms on a mesh — see booster.py)
+                fields.update(get_round_fields())
                 if self.fold is not None:
                     fields["fold"] = self.fold
                 if self.num_rows and elapsed > 0:

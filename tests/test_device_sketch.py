@@ -202,3 +202,74 @@ def test_approx_resketch_device_impl(monkeypatch):
         a.shape != np.asarray(b).shape or not np.allclose(a, np.asarray(b))
         for a, b in zip(cuts0, session.cuts)
     )
+
+
+def test_device_kernels_do_not_recompile_across_calls(monkeypatch):
+    """ADVICE r5 regression: the sketch/apply jit kernels were fresh
+    closures, so the per-dispatch approx re-sketch recompiled both every
+    boosting round. Hoisted + cached (binning._cut_points_kernel /
+    _apply_kernel), two calls with the same static config must reuse ONE
+    compiled executable (jit cache size stays 1)."""
+    monkeypatch.setenv("GRAFT_SKETCH_IMPL", "device")
+    rng = np.random.RandomState(11)
+    X1 = rng.randn(257, 6).astype(np.float32)
+    X2 = rng.randn(257, 6).astype(np.float32)  # same shape, new contents
+    w = np.ones(257, np.float32)
+
+    binning._cut_points_kernel.cache_clear()
+    binning._apply_kernel.cache_clear()
+
+    cuts1 = binning.compute_cut_points(X1, w, 32)
+    kernel = binning._cut_points_kernel(31, max(257, 31))
+    size_after_first = kernel._cache_size()
+    cuts2 = binning.compute_cut_points(X2, w, 32)
+    assert binning._cut_points_kernel(31, max(257, 31)) is kernel
+    assert kernel._cache_size() == size_after_first == 1
+
+    binning.apply_cut_points(X1, cuts1, 32)
+    akernel = binning._apply_kernel(32)
+    a_size = akernel._cache_size()
+    binning.apply_cut_points(X2, cuts2, 32)
+    assert binning._apply_kernel(32) is akernel
+    assert akernel._cache_size() == a_size == 1
+
+
+def test_approx_resketch_forces_single_round_dispatch(monkeypatch, caplog):
+    """ADVICE r5: with _rounds_per_dispatch > 1 the approx re-sketch would
+    refresh candidates once per K-round dispatch, not once per boosting
+    iteration as libxgboost's approx does. The session forces K=1 (logged);
+    GRAFT_APPROX_RESKETCH=0 restores batched dispatches."""
+    import logging
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models.booster import (
+        TrainConfig, _TrainingSession,
+    )
+    from sagemaker_xgboost_container_tpu.models.forest import Forest
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(256, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def _session():
+        cfg = TrainConfig(
+            {"tree_method": "approx", "max_bin": 16,
+             "objective": "binary:logistic", "max_depth": 3,
+             "_rounds_per_dispatch": 4}
+        )
+        return _TrainingSession(
+            cfg, DataMatrix(X, labels=y), [],
+            Forest(objective_name=cfg.objective, base_score=cfg.base_score,
+                   num_feature=X.shape[1]),
+        )
+
+    with caplog.at_level(logging.INFO):
+        session = _session()
+    assert session.approx_resketch
+    assert session.rounds_per_dispatch == 1
+    assert any("_rounds_per_dispatch" in r.message for r in caplog.records)
+
+    monkeypatch.setenv("GRAFT_APPROX_RESKETCH", "0")
+    session2 = _session()
+    assert not session2.approx_resketch
+    assert session2.rounds_per_dispatch == 4

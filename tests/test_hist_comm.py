@@ -1,0 +1,285 @@
+"""GRAFT_HIST_COMM equivalence suite: reduce-scatter histogram rounds.
+
+The reduce_scatter lowering (ops/histogram.scatter_histograms) replaces the
+full-histogram psum with ``lax.psum_scatter`` along the data axis: each
+device aggregates and scans only its d/axis_size feature slice and the
+per-shard winners merge through combine_splits_across_shards. The contract
+is BIT-IDENTICAL committed trees versus the psum lowering — same argmax,
+same tie-breaking (max gain, lowest global feature id), same node totals
+(broadcast_node_totals) — at roughly half the collective wire bytes and
+1/axis_size the split-scan FLOPs.
+
+Runs on the conftest 8-virtual-device CPU mesh (real SPMD partitioning +
+collectives without TPU hardware).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+from jax.sharding import Mesh
+
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+from sagemaker_xgboost_container_tpu.ops.histogram import (
+    padded_feature_width,
+    round_comm_plan,
+)
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+_TREE_FIELDS = (
+    "feature",
+    "threshold",
+    "default_left",
+    "left",
+    "right",
+    "value",
+    "base_weight",
+    "gain",
+    "sum_hess",
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    devices = np.array(jax.devices()[:8])
+    assert devices.size == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devices, axis_names=("data",))
+
+
+def _data(n=1024, d=11, seed=0, missing=0.12):
+    """Dense features with NaN missing cells (the sparsity-aware path)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    if missing:
+        X[rng.rand(n, d) < missing] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1 % d]) > 0).astype(
+        np.float32
+    )
+    return X, y
+
+
+def _assert_forests_bitwise(f1, f2):
+    assert len(f1.trees) == len(f2.trees) and f1.trees
+    for t1, t2 in zip(f1.trees, f2.trees):
+        for k in _TREE_FIELDS:
+            a, b = getattr(t1, k), getattr(t2, k)
+            assert np.array_equal(a, b), "tree field {!r} diverges".format(k)
+
+
+def _train_both(monkeypatch, params, X, y, mesh, rounds=4, extra_env=()):
+    """Train under psum and reduce_scatter; assert packed trees AND
+    predictions are bitwise identical; return the psum forest."""
+    for k, v in extra_env:
+        monkeypatch.setenv(k, v)
+    forests = []
+    for comm in ("psum", "reduce_scatter"):
+        monkeypatch.setenv("GRAFT_HIST_COMM", comm)
+        forests.append(
+            train(dict(params), DataMatrix(X, labels=y), num_boost_round=rounds,
+                  mesh=mesh)
+        )
+    monkeypatch.delenv("GRAFT_HIST_COMM")
+    f1, f2 = forests
+    _assert_forests_bitwise(f1, f2)
+    p1 = np.asarray(f1.predict(X), np.float32)
+    p2 = np.asarray(f2.predict(X), np.float32)
+    assert np.array_equal(p1.view(np.uint32), p2.view(np.uint32))
+    return f1
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_bitwise_depthwise(monkeypatch, mesh8):
+    # d=11 does not divide 8: features pad to 16, 2 per shard, the last
+    # shard scanning pure padding — which must never win a split
+    X, y = _data(d=11, seed=1)
+    _train_both(
+        monkeypatch,
+        {"objective": "binary:logistic", "max_depth": 4, "seed": 3},
+        X, y, mesh8,
+    )
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_bitwise_lossguide(monkeypatch, mesh8):
+    X, y = _data(d=9, seed=2)
+    _train_both(
+        monkeypatch,
+        {
+            "objective": "binary:logistic",
+            "grow_policy": "lossguide",
+            "max_leaves": 8,
+            "max_depth": 0,
+            "seed": 5,
+        },
+        X, y, mesh8,
+    )
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_bitwise_without_subtraction(monkeypatch, mesh8):
+    # the default runs exercise the subtraction cache (parent - left on the
+    # local slice); this pins the direct-histogram path for both growers
+    X, y = _data(d=11, seed=3)
+    _train_both(
+        monkeypatch,
+        {"objective": "binary:logistic", "max_depth": 4, "seed": 1},
+        X, y, mesh8,
+        extra_env=(("GRAFT_HIST_SUBTRACT", "0"),),
+    )
+    _train_both(
+        monkeypatch,
+        {
+            "objective": "binary:logistic",
+            "grow_policy": "lossguide",
+            "max_leaves": 6,
+            "max_depth": 0,
+            "seed": 1,
+        },
+        X, y, mesh8, rounds=3,
+        extra_env=(("GRAFT_HIST_SUBTRACT", "0"),),
+    )
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_bitwise_fewer_features_than_shards(monkeypatch, mesh8):
+    # d=5 < 8 shards: shards 5..7 hold pure padding columns
+    X, y = _data(d=5, seed=4)
+    _train_both(
+        monkeypatch,
+        {"objective": "reg:squarederror", "max_depth": 3, "seed": 2},
+        X, y, mesh8,
+    )
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_bitwise_sparse_input(monkeypatch, mesh8):
+    # csr input densifies with NaN (libsvm serve/train path)
+    rng = np.random.RandomState(7)
+    dense = rng.randn(800, 7).astype(np.float32)
+    dense[rng.rand(800, 7) < 0.6] = 0.0
+    X = np.asarray(
+        DataMatrix(sp.csr_matrix(dense)).features
+    )  # zeros -> NaN densification
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float32)
+    _train_both(
+        monkeypatch,
+        {"objective": "binary:logistic", "max_depth": 3, "seed": 9},
+        X, y, mesh8,
+    )
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_scan_runs_on_feature_slice(monkeypatch, mesh8):
+    """The split scan provably runs on d/axis_size features per device:
+    record the histogram widths find_best_splits traces under shard_map."""
+    from sagemaker_xgboost_container_tpu.ops import tree_build
+
+    seen = []
+    orig = tree_build.find_best_splits
+
+    def recorder(G, H, num_cuts, **kw):
+        seen.append(int(G.shape[1]))
+        return orig(G, H, num_cuts, **kw)
+
+    monkeypatch.setattr(tree_build, "find_best_splits", recorder)
+    d = 11
+    d_slice = padded_feature_width(d, 8) // 8  # 16 // 8 = 2
+    X, y = _data(d=d, seed=5)
+    monkeypatch.setenv("GRAFT_HIST_COMM", "reduce_scatter")
+    train(
+        {"objective": "binary:logistic", "max_depth": 3},
+        DataMatrix(X, labels=y),
+        num_boost_round=1,
+        mesh=mesh8,
+    )
+    assert seen and all(w == d_slice for w in seen), seen
+
+    seen.clear()
+    monkeypatch.setenv("GRAFT_HIST_COMM", "psum")
+    train(
+        {"objective": "binary:logistic", "max_depth": 3},
+        DataMatrix(X, labels=y),
+        num_boost_round=1,
+        mesh=mesh8,
+    )
+    assert seen and all(w == d for w in seen), seen
+
+
+@pytest.mark.multichip
+def test_reduce_scatter_refuses_2d_mesh(monkeypatch):
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh2d = Mesh(devices, axis_names=("data", "feature"))
+    X, y = _data(d=8, seed=6, missing=0)
+    monkeypatch.setenv("GRAFT_HIST_COMM", "reduce_scatter")
+    with pytest.raises(exc.UserError, match="psum"):
+        train(
+            {"objective": "binary:logistic", "max_depth": 3},
+            DataMatrix(X, labels=y),
+            num_boost_round=1,
+            mesh=mesh2d,
+        )
+
+
+@pytest.mark.multichip
+def test_comm_bytes_counter_and_round_fields(monkeypatch, mesh8):
+    """hist_comm_bytes_total under reduce_scatter < 0.75x the psum bytes,
+    and the training.round record carries the comm fields."""
+    from sagemaker_xgboost_container_tpu.telemetry import (
+        REGISTRY,
+        get_round_fields,
+    )
+
+    X, y = _data(d=11, seed=8)
+    params = {"objective": "binary:logistic", "max_depth": 4}
+    observed = {}
+    for comm in ("psum", "reduce_scatter"):
+        REGISTRY.reset()
+        monkeypatch.setenv("GRAFT_HIST_COMM", comm)
+        monkeypatch.setenv("GRAFT_HIST_COMM_CALIBRATE", "0")
+        train(dict(params), DataMatrix(X, labels=y), num_boost_round=3,
+              mesh=mesh8)
+        counter = REGISTRY.counter(
+            "hist_comm_bytes_total", labels={"impl": comm}
+        )
+        observed[comm] = counter.value
+        fields = get_round_fields()
+        assert fields.get("hist_comm") == comm
+        assert fields.get("hist_comm_bytes", 0) > 0
+    assert observed["psum"] > 0 and observed["reduce_scatter"] > 0
+    ratio = observed["reduce_scatter"] / observed["psum"]
+    assert ratio < 0.75, "reduce_scatter moved {:.2f}x the psum bytes".format(
+        ratio
+    )
+
+
+def test_round_comm_plan_formula():
+    """Host-side sanity of the bytes-per-round formula (docs/DESIGN.md
+    Communication): ring allreduce = 2(p-1)/p x payload, reduce-scatter =
+    (p-1)/p x padded payload."""
+    d, B, p = 28, 257, 8
+    _, ps = round_comm_plan("depthwise", 6, 0, d, B, p, "psum", False)
+    _, rs = round_comm_plan("depthwise", 6, 0, d, B, p, "reduce_scatter", False)
+    d_pad = padded_feature_width(d, p)  # 32
+    expected_ratio = d_pad / (2.0 * d)  # padded payload, half the ring factor
+    assert ps > 0 and rs > 0
+    assert abs(rs / ps - expected_ratio) < 0.02
+    # subtraction halves the per-level histogram widths -> fewer bytes
+    _, ps_sub = round_comm_plan("depthwise", 6, 0, d, B, p, "psum", True)
+    assert ps_sub < ps
+    # single shard: no collectives
+    entries, zero = round_comm_plan("depthwise", 6, 0, d, B, 1, "psum", False)
+    assert entries == [] and zero == 0
+
+
+def test_hist_comm_env_validation(monkeypatch):
+    from sagemaker_xgboost_container_tpu.ops.histogram import hist_comm_impl
+
+    monkeypatch.setenv("GRAFT_HIST_COMM", "ring")
+    with pytest.raises(ValueError, match="reduce_scatter"):
+        hist_comm_impl()
+    monkeypatch.setenv("GRAFT_HIST_COMM", "reduce_scatter")
+    assert hist_comm_impl() == "reduce_scatter"
+    monkeypatch.delenv("GRAFT_HIST_COMM")
+    assert hist_comm_impl() == "psum"

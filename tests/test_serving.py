@@ -573,6 +573,26 @@ class TestBatcher:
         m2 = csv_to_matrix(b"1,2\n,4")  # empty cell -> nan
         assert np.isnan(m2.features[1, 0])
 
+    def test_csv_single_column_incidental_whitespace(self):
+        """ADVICE r5: a single-column payload with incidental leading/
+        trailing whitespace must not sniff ' ' as the delimiter and grow a
+        phantom NaN column — the probe line is stripped first."""
+        from sagemaker_xgboost_container_tpu.serving.encoder import (
+            _sniff_delimiter, csv_to_matrix,
+        )
+
+        assert _sniff_delimiter("1.0 ") == ","
+        assert _sniff_delimiter(" 1.0") == ","
+        m = csv_to_matrix(b"1.0 ")
+        assert m.features.shape == (1, 1)
+        np.testing.assert_allclose(m.features, [[1.0]])
+        m = csv_to_matrix(b" 1.0")
+        assert m.features.shape == (1, 1)
+        np.testing.assert_allclose(m.features, [[1.0]])
+        # interior whitespace is still a real delimiter
+        m = csv_to_matrix(b"1.0 2.0\n3.0 4.0")
+        assert m.features.shape == (2, 2)
+
     def test_served_predictions_match_direct(self, abalone_model_dir):
         svc = ScoringService(abalone_model_dir)
         svc.load_model()

@@ -351,13 +351,17 @@ class AbortListener:
             pass
 
 
-def broadcast_abort(hosts, reason, source=None, port=None, timeout=2.0):
+def broadcast_abort(hosts, reason, source=None, port=None, timeout=2.0, exit_code=None):
     """Best-effort abort fan-out: one framed message per host, bounded
     connect/send timeouts, failures logged not raised (a host that's
     already dead is exactly why we're broadcasting). Returns the number of
-    hosts the frame was delivered to."""
+    hosts the frame was delivered to. ``exit_code`` (when given) rides in
+    the frame so receivers exit with the broadcaster's distinguishing code
+    (watchdog._frame_exit_code bounds it receiver-side)."""
     target_port = abort_port() if port is None else port
     frame = {"type": "abort", "reason": reason, "source": source}
+    if exit_code is not None:
+        frame["exit_code"] = int(exit_code)
     delivered = 0
     for host in hosts:
         fault_point("abort.broadcast", host=host)

@@ -41,7 +41,11 @@ from ..data.content_types import CSV, LIBSVM, RECORDIO_PROTOBUF, get_content_typ
 from ..data.recordio import record_pb2, _frame
 from ..models.compat import load_model_any_format
 from ..toolkit import exceptions as exc
+from ..utils import integrity
+from ..utils.faults import fault_point
 from . import encoder
+
+logger = logging.getLogger(__name__)
 
 PKL_FORMAT = "pkl_format"
 XGB_FORMAT = "xgb_format"
@@ -124,18 +128,80 @@ def _get_full_model_paths(model_dir):
         if os.path.isfile(path):
             if name.startswith("."):
                 continue
+            if name.endswith(integrity.MANIFEST_SUFFIX):
+                # integrity sidecars describe a model file; they are never
+                # themselves a model (an ensemble load would choke on one)
+                continue
             yield path
 
 
+def _note_model_verify_fail(stage):
+    from ..telemetry import REGISTRY
+
+    REGISTRY.counter(
+        "model_verify_fail_total",
+        "Serving model artifacts rejected at load (digest, parse, or "
+        "structural validation)",
+        {"stage": stage},
+    ).inc()
+
+
+def _load_verified(path):
+    """Load one model artifact with the full integrity gauntlet.
+
+    Three stages, each with its own ``model_verify_fail_total{stage}``
+    series so the metric names WHICH defense fired: ``digest`` (bytes
+    disagree with the sidecar manifest that traveled with the artifact),
+    ``parse`` (not loadable in any supported format), ``structure`` (parsed,
+    but the trees violate the invariants the compiled predict kernels
+    assume — children out of range, non-finite thresholds/values,
+    inconsistent bookkeeping). A corrupt artifact dies here as a distinct
+    5xx at load/ping time instead of an inscrutable predict-time error.
+    """
+    fault_point("model.load", path=path)
+    manifest = integrity.read_manifest(path)
+    if manifest is not None:
+        try:
+            integrity.verify_file_against_manifest(path, manifest)
+        except (integrity.IntegrityError, OSError) as e:
+            _note_model_verify_fail("digest")
+            logger.error("MODEL VERIFICATION FAILED (digest): %s", e)
+            raise integrity.IntegrityError(
+                "Model artifact {} failed digest verification against its "
+                "manifest: {}".format(path, e)
+            )
+    try:
+        forest, source_format = load_model_any_format(path)
+    except Exception as e:
+        _note_model_verify_fail("parse")
+        logger.error("MODEL VERIFICATION FAILED (parse): %s: %s", path, e)
+        raise
+    try:
+        integrity.validate_model(forest)
+    except integrity.IntegrityError as e:
+        _note_model_verify_fail("structure")
+        logger.error("MODEL VERIFICATION FAILED (structure): %s: %s", path, e)
+        raise integrity.IntegrityError(
+            "Model artifact {} is structurally invalid: {}".format(path, e)
+        )
+    return forest, source_format
+
+
 def get_loaded_booster(model_dir, ensemble=False):
-    """Load model file(s) from the directory; ensemble loads all of them."""
+    """Load model file(s) from the directory; ensemble loads all of them.
+
+    Every artifact goes through verified loading (``_load_verified``):
+    digest check when a manifest sidecar traveled with the model, format
+    parse, then structural validation of the trees — single-model, MME
+    load, and MME eviction/reload all share this one gate.
+    """
     paths = list(_get_full_model_paths(model_dir))
     if not paths:
         raise RuntimeError("No model files found in {}".format(model_dir))
     paths = paths if ensemble else paths[:1]
     models, formats = [], []
     for path in paths:
-        forest, source_format = load_model_any_format(path)
+        forest, source_format = _load_verified(path)
         models.append(forest)
         formats.append(source_format)
     if ensemble and len(models) > 1:

@@ -170,6 +170,12 @@ def sagemaker_train(
 
             start_abort_plane(participating_hosts, current_host)
             start_cluster_telemetry(participating_hosts, current_host)
+            # membership for the consensus guard (SM_CONSENSUS_EVERY): the
+            # digest allgather runs over the RE-FORMED cluster, same as the
+            # heartbeat plane — hosts without data already exited
+            from .consensus import register_cluster
+
+            register_cluster(participating_hosts, current_host)
 
         distributed.distributed_run(
             exec_fun=train_job,
@@ -391,6 +397,7 @@ def train_job(
                 is_master=is_master,
                 num_round=num_round,
                 num_rows=train_dmatrix.num_row,
+                train_cfg=train_cfg,
             )
             with xla_trace(), span("train", emit=True):
                 bst = booster.train(
@@ -467,6 +474,7 @@ def train_job(
                         fold=len(bst),
                         num_round=num_round,
                         num_rows=cv_train.num_row,
+                        train_cfg=train_cfg,
                     )
 
                     class _EvalsRecorder:
@@ -513,17 +521,35 @@ def train_job(
 
     os.makedirs(model_dir, exist_ok=True)
     if is_master:
+        from ..utils import integrity
+
+        def _save_with_manifest(model, model_location):
+            model.save_model(model_location)
+            try:
+                # the manifest travels inside model.tar.gz: serving
+                # digest-verifies the artifact at load. Best-effort — a
+                # failed sidecar write must not fail a finished job (the
+                # model loads manifest-less, exactly like older runs)
+                integrity.write_manifest(
+                    model_location,
+                    fingerprint=integrity.config_fingerprint(train_cfg),
+                )
+            except OSError as e:
+                logger.warning(
+                    "could not write model manifest for %s: %s", model_location, e
+                )
+
         with span("model_save", emit=True):
             if not isinstance(bst, list):
                 model_location = os.path.join(model_dir, MODEL_NAME)
-                bst.save_model(model_location)
+                _save_with_manifest(bst, model_location)
                 logger.debug("Stored trained model at %s", model_location)
             else:
                 for fold, fold_booster in enumerate(bst):
                     model_location = os.path.join(
                         model_dir, "{}-{}".format(MODEL_NAME, fold)
                     )
-                    fold_booster.save_model(model_location)
+                    _save_with_manifest(fold_booster, model_location)
                     logger.debug(
                         "Stored trained model %d at %s", fold, model_location
                     )

@@ -146,27 +146,58 @@ def get_callbacks(
     fold=None,
     num_round=None,
     num_rows=None,
+    train_cfg=None,
 ):
     """-> (xgb_model path or None, start iteration, callback list).
 
     Assembly order mirrors reference callback.py:63-123: monitor, checkpoint
     saver (master only), intermediate-model + SIGTERM, early stopping.
+
+    ``train_cfg`` (when given) feeds the integrity layer: its config
+    fingerprint is stamped into every checkpoint manifest and validated
+    against the resume candidate's manifest (warn, or refuse under
+    ``SM_RESUME_STRICT=true``).
     """
+    from ..utils import integrity
+
     if checkpoint_dir and fold is not None:
         checkpoint_dir = os.path.join(checkpoint_dir, "model-{}".format(fold))
 
+    fingerprint = (
+        integrity.config_fingerprint(train_cfg) if train_cfg is not None else None
+    )
+
     xgb_model, iteration = checkpointing.load_checkpoint(checkpoint_dir)
     if xgb_model is not None:
+        if fingerprint is not None:
+            integrity.validate_resume(xgb_model, fingerprint)
         logger.info("Checkpoint loaded from %s", xgb_model)
         logger.info("Resuming from iteration %s", iteration)
 
     callbacks = [_TimedCallback(EvaluationMonitor(), "eval_monitor")]
 
+    # consensus guard (SM_CONSENSUS_EVERY): every rank digests its committed
+    # trees and allgathers the digests every N rounds — a diverged rank takes
+    # the whole job down with exit 81 instead of training a forked ensemble
+    # to completion (digest work is host-side, off the jitted round path).
+    # MUST precede the checkpoint saver: on the detection round the abort
+    # fires before the round's checkpoint write, so a possibly-forked forest
+    # never reaches disk with a self-consistent manifest — restart resumes
+    # from the last round that PASSED consensus.
+    from .consensus import maybe_consensus_guard
+
+    guard = maybe_consensus_guard()
+    if guard is not None:
+        callbacks.append(_TimedCallback(guard, "consensus"))
+
     if checkpoint_dir and is_master:
         callbacks.append(
             _TimedCallback(
                 checkpointing.SaveCheckpointCallBack(
-                    checkpoint_dir, start_iteration=iteration, num_round=num_round
+                    checkpoint_dir,
+                    start_iteration=iteration,
+                    num_round=num_round,
+                    fingerprint=fingerprint,
                 ),
                 "checkpoint",
             )

@@ -24,12 +24,14 @@ import threading
 import time
 import weakref
 
+from ..utils import integrity
 from ..utils.faults import fault_point
 from ..utils.retry import retry_transient
 
 TEMP_FILE_SUFFIX = ".sagemaker-ignore"
 FILE_LOCK_SUFFIX = ".sagemaker-uploading"
 FILE_SAFE_SUFFIX = ".sagemaker-uploaded"
+MANIFEST_SUFFIX = integrity.MANIFEST_SUFFIX
 
 CHECKPOINT_FILENAME = "xgboost-checkpoint"
 
@@ -41,10 +43,28 @@ logger = logging.getLogger(__name__)
 _active_savers = weakref.WeakSet()
 
 
+def _note_verify_fail(reason):
+    from ..telemetry import REGISTRY
+
+    REGISTRY.counter(
+        "checkpoint_verify_fail_total",
+        "Resume candidates rejected by digest or parse validation",
+        {"reason": reason},
+    ).inc()
+
+
 def _checkpoint_usable(path):
     """Cheap integrity check for a checkpoint file.
 
-    Checkpoints are full serialized models (forest/gblinear both emit JSON;
+    With a manifest sidecar (every checkpoint since the integrity layer),
+    the sha256 digest is the verdict: a match proves the exact saved bytes
+    and SHORT-CIRCUITS the full JSON parse (digesting streams the file
+    once; parsing a multi-GB model JSON allocates its whole object tree), a
+    mismatch rejects the candidate — stronger than the parse, which accepts
+    any bit flip that stays inside JSON syntax.
+
+    Manifest-less checkpoints (older runs) keep the parse fallback:
+    checkpoints are full serialized models (forest/gblinear both emit JSON;
     the ``.ubj`` branch only triggers on an explicit suffix, which the
     extension-less ``xgboost-checkpoint.<iter>`` names never carry). A file
     killed mid-write — crash between temp-create and rename shouldn't leave
@@ -54,34 +74,64 @@ def _checkpoint_usable(path):
     try:
         if os.path.getsize(path) == 0:
             return False
+    except OSError:
+        return False
+    manifest = integrity.read_manifest(path)
+    if manifest is not None:
+        try:
+            integrity.verify_file_against_manifest(path, manifest)
+            return True
+        except integrity.IntegrityError as e:
+            logger.warning("checkpoint digest verification failed: %s", e)
+            _note_verify_fail("digest")
+            return False
+        except OSError:
+            _note_verify_fail("io")
+            return False
+    try:
         with open(path, "rb") as f:
             json.loads(f.read().decode("utf-8"))
         return True
-    except (OSError, ValueError, UnicodeDecodeError):
+    except OSError:
+        _note_verify_fail("io")
+        return False
+    except (ValueError, UnicodeDecodeError):
+        _note_verify_fail("parse")
         return False
 
 
 def load_checkpoint(checkpoint_dir):
     """-> (model path or None, next iteration number).
 
-    Picks the highest-iteration checkpoint that actually *loads* — a
-    corrupt/partial file (crash or interrupted upload-restore) is skipped
+    Picks the highest-iteration checkpoint that actually *verifies* — the
+    manifest digest where a sidecar exists, the JSON parse otherwise
+    (``_checkpoint_usable``). A corrupt/partial/bit-flipped file is skipped
     with a warning and the next-highest takes over, so one bad file can't
     turn a resumable job into a from-scratch retrain or a crash loop. Also
     sweeps orphaned ``.sagemaker-ignore`` temp files left by a crash
-    mid-``_atomic_save``.
+    mid-``_atomic_save`` and orphaned ``.manifest`` sidecars whose
+    checkpoint is gone (retention deleted it, or the pair was half-restored).
     """
     if not checkpoint_dir or not os.path.exists(checkpoint_dir):
         return None, 0
     pattern = re.compile(r"^{}\.([0-9]+)$".format(re.escape(CHECKPOINT_FILENAME)))
     found = []
-    for name in os.listdir(checkpoint_dir):
+    names = set(os.listdir(checkpoint_dir))
+    for name in sorted(names):
         if name.endswith(TEMP_FILE_SUFFIX):
             try:
                 os.remove(os.path.join(checkpoint_dir, name))
                 logger.info("removed orphaned checkpoint temp file %s", name)
             except OSError:
                 logger.debug("could not remove orphaned temp file %s", name)
+            continue
+        if name.endswith(MANIFEST_SUFFIX):
+            if name[: -len(MANIFEST_SUFFIX)] not in names:
+                try:
+                    os.remove(os.path.join(checkpoint_dir, name))
+                    logger.info("removed orphaned checkpoint manifest %s", name)
+                except OSError:
+                    logger.debug("could not remove orphaned manifest %s", name)
             continue
         m = pattern.match(name)
         if m:
@@ -97,10 +147,32 @@ def load_checkpoint(checkpoint_dir):
     return None, 0
 
 
-def _atomic_save(model, directory, final_name):
+def _atomic_save(model, directory, final_name, iteration=None, fingerprint=None):
     """tempfile + rename, with bounded transient-IO retries. Each attempt
     uses a fresh temp file and cleans up its own debris on failure, so a
-    retried save can't leak ``.sagemaker-ignore`` orphans."""
+    retried save can't leak ``.sagemaker-ignore`` orphans.
+
+    With ``iteration``/``fingerprint`` (checkpoint saves), a manifest
+    sidecar (``<final_name>.manifest``: sha256 + byte count + iteration +
+    config fingerprint) is written after the model with the same
+    atomic-retried semantics. The digest is taken from the temp file BEFORE
+    the rename — it describes the exact bytes that became the checkpoint,
+    not a re-read that could race a concurrent restore. Order matters:
+    model first, manifest second, so a crash in between leaves a
+    manifest-less checkpoint (degrades to the parse fallback) rather than a
+    manifest describing a file that doesn't exist.
+
+    Without them (the per-round intermediate model overwrite), NO manifest
+    is written — a SIGTERM can land between the two renames on any round,
+    and a sidecar describing the previous round's bytes would make serving
+    reject the perfectly fresh model the spot-interruption contract just
+    saved. Instead any stale sidecar for the name (e.g. the final-model
+    manifest of a previous completed run in the same model_dir) is removed,
+    keeping the invariant: a manifest, when present, describes the current
+    bytes.
+    """
+    digest_box = {}
+    want_manifest = iteration is not None or fingerprint is not None
 
     def _attempt():
         fault_point("checkpoint.save", path=final_name)
@@ -110,6 +182,20 @@ def _atomic_save(model, directory, final_name):
             tmp = tf.name
         try:
             model.save_model(tmp)
+            if want_manifest:
+                digest_box["sha256"] = integrity.file_digest(tmp)
+                digest_box["bytes"] = os.path.getsize(tmp)
+                # re-saving an existing name (resume re-writes a rejected
+                # iteration): drop the old sidecar BEFORE the rename, so a
+                # crash in the rename->manifest window leaves new bytes
+                # manifest-less (parse fallback) rather than new bytes +
+                # a stale manifest that would verify-fail a good checkpoint
+                try:
+                    os.remove(
+                        os.path.join(directory, final_name + MANIFEST_SUFFIX)
+                    )
+                except OSError:
+                    pass
             os.rename(tmp, os.path.join(directory, final_name))
         except BaseException:
             try:
@@ -119,6 +205,39 @@ def _atomic_save(model, directory, final_name):
             raise
 
     retry_transient(_attempt, site="checkpoint.save")
+    if not want_manifest:
+        try:
+            os.remove(os.path.join(directory, final_name + MANIFEST_SUFFIX))
+        except OSError:
+            pass
+        return
+    manifest = integrity.build_manifest(
+        os.path.join(directory, final_name),
+        iteration=iteration,
+        fingerprint=fingerprint,
+        digest=digest_box["sha256"],
+        size=digest_box["bytes"],
+    )
+    _atomic_write_manifest(directory, final_name + MANIFEST_SUFFIX, manifest)
+
+
+def _atomic_write_manifest(directory, manifest_name, manifest):
+    """Write the manifest sidecar: tempfile + rename under ``retry_transient``
+    with per-attempt temp cleanup — the same durability contract as the
+    model write it describes (a manifest that can be torn by a crash would
+    reject the healthy checkpoint it sits next to)."""
+
+    def _attempt():
+        fault_point("checkpoint.manifest", path=manifest_name)
+        with tempfile.NamedTemporaryFile(
+            dir=directory, suffix=TEMP_FILE_SUFFIX, delete=False, mode="w"
+        ) as tf:
+            tmp = tf.name
+        integrity.dump_manifest_atomic(
+            os.path.join(directory, manifest_name), manifest, tmp
+        )
+
+    retry_transient(_attempt, site="checkpoint.manifest")
 
 
 def flush_checkpoints(timeout=10.0):
@@ -141,11 +260,21 @@ class SaveCheckpointCallBack:
 
     SENTINEL = None
 
-    def __init__(self, checkpoint_dir, start_iteration=0, max_to_keep=5, num_round=None):
+    def __init__(
+        self,
+        checkpoint_dir,
+        start_iteration=0,
+        max_to_keep=5,
+        num_round=None,
+        fingerprint=None,
+    ):
         self.checkpoint_dir = checkpoint_dir
         self.max_to_keep = max_to_keep
         self.start_iteration = start_iteration
         self.num_round = num_round
+        # config fingerprint stamped into every manifest sidecar; the resume
+        # validator (utils/integrity.validate_resume) compares it on restart
+        self.fingerprint = fingerprint
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.previous_checkpoints = {
             os.path.join(checkpoint_dir, f) for f in os.listdir(checkpoint_dir)
@@ -161,7 +290,11 @@ class SaveCheckpointCallBack:
 
     def after_iteration(self, model, epoch, evals_log):
         _atomic_save(
-            model, self.checkpoint_dir, "{}.{}".format(CHECKPOINT_FILENAME, epoch)
+            model,
+            self.checkpoint_dir,
+            "{}.{}".format(CHECKPOINT_FILENAME, epoch),
+            iteration=epoch,
+            fingerprint=self.fingerprint,
         )
         self.delete_queue.put(epoch - self.max_to_keep)
         if self.num_round is not None and epoch + 1 >= self.num_round:
@@ -181,9 +314,24 @@ class SaveCheckpointCallBack:
 
         def _remove(path):
             try:
-                os.remove(path)
-            except OSError:
-                logger.debug("Failed to delete %s", path)
+                try:
+                    os.remove(path)
+                except OSError:
+                    # checkpoint survived the delete (EACCES, upload-lock
+                    # race): its sidecar must survive too — stripping the
+                    # manifest from a live checkpoint would downgrade a later
+                    # resume to the parse fallback, losing bit-rot detection.
+                    # load_checkpoint sweeps the sidecar once the checkpoint
+                    # is truly gone.
+                    logger.debug("Failed to delete %s", path)
+                else:
+                    # the sidecar lives and dies with its checkpoint:
+                    # retention must never leak one (a stale manifest next to
+                    # a later re-used name would reject a good file)
+                    try:
+                        os.remove(path + MANIFEST_SUFFIX)
+                    except OSError:
+                        pass
             finally:
                 self.delete_queue.task_done()
 

@@ -182,10 +182,21 @@ def maybe_round_watchdog():
 
 
 # ------------------------------------------------------------- abort plane
+def _frame_exit_code(msg):
+    """Exit code carried by an abort frame, defaulting to the cluster-abort
+    code. Bounded to the supervision range (79-99) so a malformed/malicious
+    frame can't make a rank exit 0 (platform would NOT restart it)."""
+    try:
+        code = int(msg.get("exit_code", EXIT_CLUSTER_ABORT))
+    except (TypeError, ValueError):
+        return EXIT_CLUSTER_ABORT
+    return code if 79 <= code <= 99 else EXIT_CLUSTER_ABORT
+
+
 def _on_abort_frame(msg):
     request_abort(
         str(msg.get("reason", "cluster_abort")),
-        EXIT_CLUSTER_ABORT,
+        _frame_exit_code(msg),
         source=msg.get("source"),
     )
 
@@ -218,13 +229,19 @@ def start_abort_plane(hosts, current_host):
     return listener
 
 
-def coordinate_abort(hosts, current_host, reason, **fields):
-    """Rank 0: broadcast one abort frame to every peer, then abort locally."""
+def coordinate_abort(hosts, current_host, reason, exit_code=EXIT_CLUSTER_ABORT, **fields):
+    """Rank 0: broadcast one abort frame to every peer, then abort locally.
+
+    ``exit_code`` rides inside the frame so every rank exits with the SAME
+    distinguishing code (80 for stale-host aborts, 81 for consensus
+    divergence) — the job log's exit code names the supervisor that fired
+    no matter which rank's log you're reading.
+    """
     from ..parallel.distributed import broadcast_abort
 
     peers = [h for h in hosts if h != current_host]
-    delivered = broadcast_abort(peers, reason, source=current_host)
+    delivered = broadcast_abort(peers, reason, source=current_host, exit_code=exit_code)
     logger.error(
         "coordinated abort (%s): notified %d/%d peers", reason, delivered, len(peers)
     )
-    request_abort(reason, EXIT_CLUSTER_ABORT, peers_notified=delivered, **fields)
+    request_abort(reason, exit_code, peers_notified=delivered, **fields)

@@ -1,0 +1,478 @@
+"""State integrity: content digests, manifest sidecars, model validation.
+
+The container's durable state travels through paths that trust bytes
+blindly: a restarted training job resumes from whatever
+``xgboost-checkpoint.<iter>`` parses, and a serving endpoint loads whatever
+artifact lands in the model dir. This module is the shared vocabulary that
+closes that gap:
+
+* **content digests** — sha256 over exact file bytes (``file_digest``) and
+  over a Forest's committed trees in a canonical packed byte layout
+  (``forest_digest``, the host mirror of the packed-tree u32 view the
+  distributed bit-identity tests assert on),
+* **manifest sidecars** — a versioned JSON file next to every checkpoint
+  (``<name>.manifest``): model digest + byte count, boosting iteration, and
+  a config fingerprint (objective/tree_method/max_bin/max_depth/world size/
+  versions). ``training/checkpointing._atomic_save`` writes them;
+  ``load_checkpoint`` refuses candidates whose digest disagrees,
+* **resume validation** — ``validate_resume`` compares a checkpoint's
+  fingerprint against the live job's and warns (or refuses under
+  ``SM_RESUME_STRICT=true``): resuming under a different binning or
+  objective config silently forks the model,
+* **model validation** — ``check_model_file`` (digest, when a manifest
+  travels with the artifact) + ``validate_model`` (structural: children in
+  range, finite thresholds/values, consistent tree bookkeeping) turn a
+  corrupt serving artifact into one clear load-time error instead of an
+  inscrutable downstream predict failure.
+
+Everything here is host-side numpy/hashlib — nothing touches the jitted
+round path, so integrity checks add no device work.
+"""
+
+import hashlib
+import json
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_SUFFIX = ".manifest"
+MANIFEST_VERSION = 1
+
+RESUME_STRICT_ENV = "SM_RESUME_STRICT"
+
+# config keys whose disagreement between a checkpoint and the live job means
+# the resumed model would be built under different split candidates or a
+# different loss — the silent-fork failure mode the resume validator exists
+# to catch. Version/world-size drift is reported too but carries its own
+# line so the operator can tell re-shard from re-config.
+_FINGERPRINT_KEYS = (
+    "objective",
+    "tree_method",
+    "max_bin",
+    "max_depth",
+    "world_size",
+    "jax_version",
+    "package_version",
+)
+
+
+class IntegrityError(RuntimeError):
+    """A state artifact failed digest or structural verification."""
+
+
+def resume_strict():
+    from .envconfig import env_bool
+
+    return env_bool(RESUME_STRICT_ENV, False)
+
+
+# ------------------------------------------------------------------ digests
+def sha256_bytes(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def file_digest(path, chunk_size=1 << 20):
+    """Streaming sha256 of a file's exact bytes."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_size), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# canonical (name, dtype) layout for one tree's arrays. Float fields hash
+# their raw IEEE bytes — the same u32-view identity the hist-comm
+# equivalence suite asserts — so two trees agree iff they are bit-identical,
+# not merely approximately equal.
+_TREE_DIGEST_FIELDS = (
+    ("feature", np.int32),
+    ("threshold", np.float32),
+    ("default_left", np.uint8),
+    ("left", np.int32),
+    ("right", np.int32),
+    ("value", np.float32),
+    ("base_weight", np.float32),
+    ("gain", np.float32),
+    ("sum_hess", np.float32),
+)
+
+
+def forest_digest(model):
+    """sha256 over the model's committed state in canonical packed bytes.
+
+    Tree models: every tree field the trainer commits (including
+    categorical-split category sets on BYO/refreshed models) plus the
+    per-tree class ids and round boundaries — i.e. exactly the state that
+    must agree across ranks under the bit-identical-trees contract. Linear
+    models (gblinear): the weight and bias arrays. Deterministic across
+    processes/hosts (fixed field order, fixed dtypes).
+    """
+    h = hashlib.sha256()
+    trees = getattr(model, "trees", None)
+    if trees is None:
+        # gblinear: the consensus-relevant state is weights + bias
+        h.update(b"linear")
+        for name in ("weights", "bias"):
+            arr = np.ascontiguousarray(
+                np.asarray(getattr(model, name, np.zeros(0)), np.float32)
+            )
+            h.update(arr.tobytes())
+        return h.hexdigest()
+    h.update(np.asarray(model.tree_info, np.int32).tobytes())
+    h.update(np.asarray(model.iteration_indptr, np.int64).tobytes())
+    for tree in trees:
+        for name, dtype in _TREE_DIGEST_FIELDS:
+            arr = np.ascontiguousarray(np.asarray(getattr(tree, name), dtype))
+            h.update(arr.tobytes())
+        for node in sorted(getattr(tree, "categories", {}) or {}):
+            cats = np.ascontiguousarray(np.asarray(tree.categories[node], np.int64))
+            # node id + set size prefix the variable-length array so
+            # {1: [2]} can never collide with {1: [], 2: []} (same
+            # injectivity rule as the per-tree node-count prefix below)
+            h.update(np.asarray([node, cats.size], np.int64).tobytes())
+            h.update(cats.tobytes())
+        # length-prefix per tree so (tree of 3 nodes + tree of 5) can never
+        # collide with (tree of 5 + tree of 3) concatenations
+        h.update(np.asarray([tree.num_nodes], np.int64).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------- manifests
+def manifest_path(model_path):
+    return str(model_path) + MANIFEST_SUFFIX
+
+
+def build_manifest(model_path, iteration=None, fingerprint=None, digest=None, size=None):
+    """Manifest dict for a model file — THE schema definition; every writer
+    (checkpoint sidecars, final-model sidecars) goes through here. ``digest``
+    / ``size`` override the on-disk read for callers that measured the temp
+    file before renaming it into place."""
+    manifest = {
+        "manifest_version": MANIFEST_VERSION,
+        "sha256": digest if digest is not None else file_digest(model_path),
+        "bytes": int(size) if size is not None else os.path.getsize(model_path),
+    }
+    if iteration is not None:
+        manifest["iteration"] = int(iteration)
+    if fingerprint is not None:
+        manifest["fingerprint"] = dict(fingerprint)
+    return manifest
+
+
+def dump_manifest_atomic(target_path, manifest, tmp_path):
+    """THE manifest serialization + atomic landing: write ``manifest`` as
+    sorted-key JSON to ``tmp_path``, rename over ``target_path``, and remove
+    the temp on any failure. Both sidecar writers (checkpoint manifests with
+    their retry wrapper, final-model manifests) go through here so the wire
+    format and the no-debris guarantee can never diverge."""
+    try:
+        with open(tmp_path, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+        os.replace(tmp_path, target_path)
+    except BaseException:
+        try:
+            os.remove(tmp_path)
+        except OSError:
+            pass
+        raise
+
+
+def write_manifest(model_path, iteration=None, fingerprint=None):
+    """Write ``model_path``'s sidecar manifest (tmp + rename, best-effort
+    atomic). Used for final model artifacts in ``model_dir`` — serving's
+    ``check_model_file`` digest-verifies any artifact whose manifest
+    traveled with it. (Checkpoint manifests go through the checkpoint
+    layer's retried atomic writer instead.)"""
+    manifest = build_manifest(model_path, iteration=iteration, fingerprint=fingerprint)
+    target = manifest_path(model_path)
+    # dot-prefixed temp: the serving loader skips dotfiles, so a crash here
+    # can never leave a file the model dir scan would try to load (nor
+    # package temp debris into model.tar.gz)
+    tmp = os.path.join(
+        os.path.dirname(target) or ".", "." + os.path.basename(target) + ".tmp"
+    )
+    dump_manifest_atomic(target, manifest, tmp)
+    return manifest
+
+
+def read_manifest(model_path):
+    """Manifest dict for ``model_path``'s sidecar, or None.
+
+    Missing sidecar -> None (older runs are manifest-less by design). A
+    sidecar that exists but doesn't parse or lacks the digest returns None
+    with a warning — the caller falls back to content-level validation, the
+    exact behavior a corrupt *model* gets.
+    """
+    path = manifest_path(model_path)
+    try:
+        with open(path, "r") as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        logger.warning("ignoring unreadable manifest %s: %s", path, e)
+        return None
+    if not isinstance(manifest, dict) or not isinstance(manifest.get("sha256"), str):
+        logger.warning("ignoring malformed manifest %s (no sha256)", path)
+        return None
+    if manifest.get("bytes") is not None:
+        # a bit-rotted sidecar can be valid JSON with a garbage byte count;
+        # it must degrade to "no usable manifest" (content-level fallback),
+        # never crash the resume scan or the serving load
+        try:
+            manifest["bytes"] = int(manifest["bytes"])
+        except (TypeError, ValueError):
+            logger.warning("ignoring malformed manifest %s (bad byte count)", path)
+            return None
+    return manifest
+
+
+def verify_file_against_manifest(model_path, manifest):
+    """Raise IntegrityError when the file's bytes disagree with the manifest.
+
+    ``manifest`` must come from :func:`read_manifest`, which guarantees a
+    string digest and an int (or absent) byte count — anything less usable
+    was already degraded to ``None`` there.
+    """
+    expected = manifest["sha256"]
+    size = manifest.get("bytes")
+    if size is not None and os.path.getsize(model_path) != int(size):
+        raise IntegrityError(
+            "{}: byte count {} != manifest {}".format(
+                model_path, os.path.getsize(model_path), size
+            )
+        )
+    actual = file_digest(model_path)
+    if actual != expected:
+        raise IntegrityError(
+            "{}: sha256 {} != manifest {}".format(model_path, actual, expected)
+        )
+
+
+def check_model_file(model_path):
+    """Digest-verify ``model_path`` against its sidecar manifest.
+
+    -> ``"verified"`` when a manifest exists and the digest matches,
+    ``"no_manifest"`` when no (usable) sidecar travels with the artifact
+    (older runs, BYO models). Raises :class:`IntegrityError` on mismatch.
+    """
+    manifest = read_manifest(model_path)
+    if manifest is None:
+        return "no_manifest"
+    verify_file_against_manifest(model_path, manifest)
+    return "verified"
+
+
+# -------------------------------------------------------------- fingerprint
+def config_fingerprint(train_cfg, world_size=None):
+    """The live job's config identity, as stored in checkpoint manifests.
+
+    Captures the knobs that change split candidates or the loss (objective,
+    tree_method, max_bin, max_depth), the data-parallel world size (binning
+    merges per-host sketches, so a resharded resume re-bins), and the
+    jax/package versions (a partial restart under version skew is how ranks
+    end up tracing different round programs).
+    """
+    cfg = dict(train_cfg or {})
+    if world_size is None:
+        world_size = _live_world_size()
+    return {
+        "objective": str(cfg.get("objective", "reg:squarederror")),
+        "tree_method": str(cfg.get("tree_method", "auto")),
+        "max_bin": str(cfg.get("max_bin", "")),
+        "max_depth": str(cfg.get("max_depth", "")),
+        "world_size": int(world_size),
+        "jax_version": _jax_version(),
+        "package_version": _package_version(),
+    }
+
+
+def _live_world_size():
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # jax absent or uninitialized: single-process
+        return 1
+
+
+def _jax_version():
+    try:
+        import jax
+
+        return str(jax.__version__)
+    except Exception:
+        return "absent"
+
+
+def _package_version():
+    try:
+        from .. import __version__
+
+        return str(__version__)
+    except Exception:
+        return "unknown"
+
+
+def fingerprint_mismatches(expected, actual):
+    """[(key, expected, actual), ...] for keys present in either dict."""
+    out = []
+    for key in _FINGERPRINT_KEYS:
+        if key in (expected or {}) or key in (actual or {}):
+            ev = (expected or {}).get(key)
+            av = (actual or {}).get(key)
+            if str(ev) != str(av):
+                out.append((key, ev, av))
+    return out
+
+
+def validate_resume(checkpoint_path, live_fingerprint):
+    """Compare the resume candidate's manifest fingerprint to the live job.
+
+    Manifest-less checkpoints (older runs) pass silently. A fingerprint
+    mismatch warns with the differing keys; under ``SM_RESUME_STRICT=true``
+    it refuses (UserError) — resuming a hist model under different binning
+    or a different objective silently changes what the remaining rounds
+    optimize, the exact failure this guard exists to surface.
+    """
+    if checkpoint_path is None:
+        return True
+    manifest = read_manifest(checkpoint_path)
+    if manifest is None or "fingerprint" not in manifest:
+        return True
+    diffs = fingerprint_mismatches(manifest["fingerprint"], live_fingerprint)
+    if not diffs:
+        return True
+    detail = ", ".join(
+        "{}: checkpoint={!r} live={!r}".format(k, ev, av) for k, ev, av in diffs
+    )
+    if resume_strict():
+        from ..toolkit import exceptions as exc
+
+        raise exc.UserError(
+            "Refusing to resume from {}: its config fingerprint disagrees "
+            "with the live job ({}). Align the configuration or clear the "
+            "checkpoint dir; set {}=false to resume anyway (the remaining "
+            "rounds would train under different binning/objective "
+            "semantics).".format(checkpoint_path, detail, RESUME_STRICT_ENV)
+        )
+    logger.warning(
+        "resuming from %s despite a config-fingerprint mismatch (%s); the "
+        "remaining rounds will train under the LIVE config — set %s=true to "
+        "refuse instead",
+        checkpoint_path,
+        detail,
+        RESUME_STRICT_ENV,
+    )
+    return False
+
+
+# --------------------------------------------------------- model validation
+def _require(cond, tree_idx, message):
+    if not cond:
+        raise IntegrityError("tree {}: {}".format(tree_idx, message))
+
+
+def validate_model(model):
+    """Structural validation of a loaded model; raises IntegrityError.
+
+    For tree models (Forest): every tree's arrays are consistent lengths,
+    child indices of split nodes land inside the tree (and never self-loop),
+    split thresholds and leaf values are finite, split feature ids are in
+    range, and the forest bookkeeping (tree_info, iteration_indptr) matches
+    the tree list. For linear models: finite weights. Anything else (user
+    module model_fn objects) passes — their contract is their own.
+
+    These are exactly the invariants the compiled predict kernels assume; a
+    violated one produces garbage predictions or out-of-bounds gathers deep
+    inside XLA, which is why a corrupt artifact must die HERE with a
+    nameable error.
+    """
+    if isinstance(model, list):
+        for m in model:
+            validate_model(m)
+        return
+    trees = getattr(model, "trees", None)
+    if trees is None:
+        weights = getattr(model, "weights", None)
+        if weights is not None and not np.all(np.isfinite(np.asarray(weights))):
+            raise IntegrityError("linear model has non-finite weights")
+        return
+    num_feature = int(getattr(model, "num_feature", 0) or 0)
+    num_group = int(getattr(model, "num_output_group", 1) or 1)
+    tree_info = list(getattr(model, "tree_info", []))
+    indptr = list(getattr(model, "iteration_indptr", [0, len(trees)]))
+    if len(tree_info) != len(trees):
+        raise IntegrityError(
+            "tree_info length {} != {} trees".format(len(tree_info), len(trees))
+        )
+    if any(not 0 <= int(c) < num_group for c in tree_info):
+        raise IntegrityError(
+            "tree_info class ids out of range for {} output group(s)".format(num_group)
+        )
+    if (
+        not indptr
+        or indptr[0] != 0
+        or indptr[-1] != len(trees)
+        or any(b < a for a, b in zip(indptr, indptr[1:]))
+    ):
+        raise IntegrityError(
+            "iteration_indptr is not a monotone partition of {} trees".format(len(trees))
+        )
+    for i, tree in enumerate(trees):
+        n = int(tree.num_nodes)
+        _require(n >= 1, i, "empty tree")
+        for field in ("threshold", "default_left", "left", "right", "value"):
+            _require(
+                len(np.asarray(getattr(tree, field))) == n,
+                i,
+                "field {!r} length != {} nodes".format(field, n),
+            )
+        left = np.asarray(tree.left, np.int64)
+        right = np.asarray(tree.right, np.int64)
+        is_leaf = left < 0
+        _require(
+            bool(np.all((right < 0) == is_leaf)),
+            i,
+            "split nodes must have both children (left/right leaf flags disagree)",
+        )
+        split = ~is_leaf
+        if np.any(split):
+            ids = np.nonzero(split)[0]
+            _require(
+                bool(np.all((left[split] < n) & (right[split] < n))),
+                i,
+                "child index out of range (>= {} nodes)".format(n),
+            )
+            _require(
+                bool(np.all((left[split] != ids) & (right[split] != ids))),
+                i,
+                "split node is its own child",
+            )
+            # categorical split nodes route by the per-node category set,
+            # not the threshold — some xgboost exporters leave NaN there
+            numeric_split = split.copy()
+            for node in getattr(tree, "categories", {}) or {}:
+                if 0 <= int(node) < n:
+                    numeric_split[int(node)] = False
+            _require(
+                bool(np.all(np.isfinite(np.asarray(tree.threshold)[numeric_split]))),
+                i,
+                "non-finite split threshold",
+            )
+            feature = np.asarray(tree.feature, np.int64)[split]
+            _require(bool(np.all(feature >= 0)), i, "negative split feature id")
+            if num_feature > 0:
+                _require(
+                    bool(np.all(feature < num_feature)),
+                    i,
+                    "split feature id >= num_feature {}".format(num_feature),
+                )
+        _require(
+            bool(np.all(np.isfinite(np.asarray(tree.value)[is_leaf]))),
+            i,
+            "non-finite leaf value",
+        )

@@ -48,6 +48,8 @@ case "$TIER" in
       python -m pytest tests/ -q "${COV_ARGS[@]}"
     ;;
   chaos)
+    # failure-domain supervision + state-integrity drills (test_robustness,
+    # test_faults, test_integrity — everything marked `chaos`)
     python -m pytest tests/ -q -m chaos
     ;;
   *)

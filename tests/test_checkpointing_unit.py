@@ -65,8 +65,14 @@ def _run_rounds(cb, rounds, start=0):
 
 
 def _checkpoints(tmp_path):
+    # checkpoint files only — each also carries a .manifest sidecar whose
+    # lifecycle (written with, deleted with, swept when orphaned) is covered
+    # by tests/test_integrity.py
     return sorted(
-        f for f in os.listdir(tmp_path) if f.startswith("xgboost-checkpoint.")
+        f
+        for f in os.listdir(tmp_path)
+        if f.startswith("xgboost-checkpoint.")
+        and not f.endswith(checkpointing.MANIFEST_SUFFIX)
     )
 
 

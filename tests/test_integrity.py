@@ -1,0 +1,828 @@
+"""State-integrity suite: checksummed checkpoints, cross-rank consensus,
+verified serving loads.
+
+Chaos-tier coverage for the integrity layer (docs/robustness.md
+§Integrity): bit-flipped/truncated checkpoints rejected by digest with
+fallback to the next-highest, manifest lifecycle (retention, orphan sweep,
+retried atomic writes), the resume fingerprint validator, the cross-rank
+tree-digest consensus guard (unit + real-socket allgather + a subprocess
+drill proving every rank exits 81), and verified model loading on the
+serving side (digest / parse / structure failures -> distinct 5xx +
+``model_verify_fail_total``).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.constants import EXIT_CONSENSUS_DIVERGENCE
+from sagemaker_xgboost_container_tpu.serving import serve_utils
+from sagemaker_xgboost_container_tpu.serving.app import ScoringService, make_app
+from sagemaker_xgboost_container_tpu.telemetry import REGISTRY
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+from sagemaker_xgboost_container_tpu.training import checkpointing, consensus
+from sagemaker_xgboost_container_tpu.training.checkpointing import (
+    MANIFEST_SUFFIX,
+    SaveCheckpointCallBack,
+    _atomic_save,
+    _checkpoint_usable,
+    load_checkpoint,
+)
+from sagemaker_xgboost_container_tpu.utils import faults, integrity
+from tests.util_ports import free_port
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.setenv("SM_IO_RETRY_BACKOFF_S", "0.001")
+    consensus._reset_for_tests()
+    yield
+    faults.reset()
+    consensus._reset_for_tests()
+
+
+class _JsonModel:
+    """save_model contract emitting valid checkpoint JSON."""
+
+    def __init__(self, tag="m"):
+        self.tag = tag
+
+    def save_model(self, path):
+        with open(path, "w") as f:
+            json.dump({"tag": self.tag}, f)
+
+
+def _counter_value(name, labels=None):
+    return REGISTRY.counter(name, labels=labels).value
+
+
+_FOREST_CACHE = {}
+
+
+def _tiny_forest(seed=0, rounds=2):
+    """A real trained forest (single device, tiny shapes); memoized — every
+    consumer either reads it or mutates a deepcopy."""
+    key = (seed, rounds)
+    if key not in _FOREST_CACHE:
+        from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+        from sagemaker_xgboost_container_tpu.models import train
+
+        rng = np.random.RandomState(seed)
+        X = rng.randn(64, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        _FOREST_CACHE[key] = train(
+            {"objective": "binary:logistic", "max_depth": 3},
+            DataMatrix(X, labels=y),
+            num_boost_round=rounds,
+        )
+    return _FOREST_CACHE[key]
+
+
+# ----------------------------------------------------------- manifest basics
+
+
+def test_atomic_save_writes_verified_manifest(tmp_path):
+    _atomic_save(
+        _JsonModel(), str(tmp_path), "xgboost-checkpoint.0",
+        iteration=0, fingerprint={"objective": "reg:squarederror"},
+    )
+    model_path = tmp_path / "xgboost-checkpoint.0"
+    manifest = integrity.read_manifest(str(model_path))
+    assert manifest is not None
+    assert manifest["manifest_version"] == integrity.MANIFEST_VERSION
+    assert manifest["sha256"] == integrity.file_digest(str(model_path))
+    assert manifest["bytes"] == os.path.getsize(str(model_path))
+    assert manifest["iteration"] == 0
+    assert manifest["fingerprint"]["objective"] == "reg:squarederror"
+    assert integrity.check_model_file(str(model_path)) == "verified"
+
+
+def test_bit_flipped_checkpoint_rejected_falls_back_to_next_highest(tmp_path):
+    """Acceptance: a single flipped byte in the newest checkpoint is caught
+    by the digest and resume proceeds from the next-highest checkpoint."""
+    for i in range(3):
+        _atomic_save(
+            _JsonModel("round-{}".format(i)), str(tmp_path),
+            "xgboost-checkpoint.{}".format(i), iteration=i,
+        )
+    newest = tmp_path / "xgboost-checkpoint.2"
+    raw = bytearray(newest.read_bytes())
+    raw[len(raw) // 2] ^= 0x01  # one flipped bit, still valid JSON bytes or not
+    newest.write_bytes(bytes(raw))
+    before = _counter_value("checkpoint_verify_fail_total", {"reason": "digest"})
+    path, iteration = load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "xgboost-checkpoint.1")
+    assert iteration == 2  # resumes AFTER round 1
+    assert (
+        _counter_value("checkpoint_verify_fail_total", {"reason": "digest"})
+        == before + 1
+    )
+
+
+def test_truncated_checkpoint_rejected_by_digest(tmp_path):
+    _atomic_save(_JsonModel("a"), str(tmp_path), "xgboost-checkpoint.0", iteration=0)
+    _atomic_save(_JsonModel("bb"), str(tmp_path), "xgboost-checkpoint.1", iteration=1)
+    newest = tmp_path / "xgboost-checkpoint.1"
+    newest.write_bytes(newest.read_bytes()[:4])  # torn restore
+    path, iteration = load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "xgboost-checkpoint.0")
+    assert iteration == 1
+
+
+def test_verified_manifest_short_circuits_json_parse(tmp_path):
+    """Digest match must skip the full JSON parse: a file whose bytes are
+    NOT valid JSON but match the manifest digest is accepted — direct proof
+    the parse never ran (it would reject these bytes)."""
+    blob = b"\x00\x01not json at all\xff"
+    model_path = tmp_path / "xgboost-checkpoint.4"
+    model_path.write_bytes(blob)
+    integrity.write_manifest(str(model_path), iteration=4)
+    assert _checkpoint_usable(str(model_path)) is True
+
+
+def test_manifestless_checkpoint_keeps_parse_fallback(tmp_path):
+    ok = tmp_path / "xgboost-checkpoint.0"
+    ok.write_text('{"valid": true}')
+    bad = tmp_path / "xgboost-checkpoint.1"
+    bad.write_text('{"truncated": ')
+    assert _checkpoint_usable(str(ok)) is True
+    assert _checkpoint_usable(str(bad)) is False
+    path, iteration = load_checkpoint(str(tmp_path))
+    assert path == str(ok) and iteration == 1
+
+
+# ------------------------------------------------- retention + orphan sweeps
+
+
+def test_retention_deleter_removes_manifest_with_checkpoint(tmp_path):
+    saver = SaveCheckpointCallBack(str(tmp_path), max_to_keep=2)
+    model = _JsonModel()
+    for epoch in range(5):
+        saver.after_iteration(model, epoch, {})
+    saver.stop()
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "xgboost-checkpoint.3" in names and "xgboost-checkpoint.4" in names
+    assert "xgboost-checkpoint.3" + MANIFEST_SUFFIX in names
+    assert "xgboost-checkpoint.4" + MANIFEST_SUFFIX in names
+    # deleted checkpoints took their sidecars with them: no leaked manifests
+    leaked = [
+        n for n in names
+        if n.endswith(MANIFEST_SUFFIX) and n[: -len(MANIFEST_SUFFIX)] not in names
+    ]
+    assert leaked == [], names
+    assert not any(n.startswith("xgboost-checkpoint.0") for n in names), names
+
+
+def test_load_checkpoint_sweeps_orphaned_manifests(tmp_path):
+    _atomic_save(_JsonModel(), str(tmp_path), "xgboost-checkpoint.7", iteration=7)
+    orphan = tmp_path / ("xgboost-checkpoint.3" + MANIFEST_SUFFIX)
+    orphan.write_text('{"sha256": "dead", "bytes": 1}')
+    path, iteration = load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "xgboost-checkpoint.7") and iteration == 8
+    assert not orphan.exists(), "orphaned manifest must be swept"
+    assert (tmp_path / ("xgboost-checkpoint.7" + MANIFEST_SUFFIX)).exists()
+
+
+def test_manifest_write_retries_with_per_attempt_cleanup(tmp_path):
+    """A transient IO error during the manifest write retries (same
+    ``retry_transient`` contract as the model write) and leaks no
+    ``.sagemaker-ignore`` temp debris."""
+    faults.configure("checkpoint.manifest:error:transient blip@1")
+    _atomic_save(_JsonModel(), str(tmp_path), "xgboost-checkpoint.0", iteration=0)
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "xgboost-checkpoint.0" in names
+    assert "xgboost-checkpoint.0" + MANIFEST_SUFFIX in names
+    assert not [n for n in names if n.endswith(checkpointing.TEMP_FILE_SUFFIX)], names
+    assert faults.fault_counts().get("checkpoint.manifest") == 1
+
+
+def test_manifest_write_exhaustion_propagates(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "2")
+    faults.configure("checkpoint.manifest:error:disk gone@1+")
+    with pytest.raises(OSError):
+        _atomic_save(_JsonModel(), str(tmp_path), "xgboost-checkpoint.0", iteration=0)
+    # the model itself landed (manifest is written after the rename)
+    assert (tmp_path / "xgboost-checkpoint.0").exists()
+    names = os.listdir(str(tmp_path))
+    assert not [n for n in names if n.endswith(checkpointing.TEMP_FILE_SUFFIX)], names
+
+
+def test_corrupt_but_parsable_sidecar_degrades_to_content_fallback(tmp_path):
+    """A bit-rotted sidecar that is still valid JSON (garbage byte count, or
+    a non-string digest) must degrade to 'no usable manifest' — the healthy
+    checkpoint next to it stays resumable via the parse fallback instead of
+    crashing the resume scan."""
+    model_path = tmp_path / "xgboost-checkpoint.0"
+    model_path.write_text('{"valid": true}')
+    sidecar = tmp_path / ("xgboost-checkpoint.0" + MANIFEST_SUFFIX)
+    sidecar.write_text(json.dumps({"sha256": "ab" * 32, "bytes": "12x456"}))
+    assert integrity.read_manifest(str(model_path)) is None
+    assert _checkpoint_usable(str(model_path)) is True  # parse fallback
+    sidecar.write_text(json.dumps({"sha256": 12345}))
+    assert integrity.read_manifest(str(model_path)) is None
+    path, iteration = load_checkpoint(str(tmp_path))
+    assert path == str(model_path) and iteration == 1
+
+
+def test_consensus_guard_ordered_before_checkpoint_saver(tmp_path, monkeypatch):
+    """On the detection round the abort must fire BEFORE the round's
+    checkpoint write, so a possibly-forked forest never reaches disk with a
+    self-consistent manifest."""
+    from sagemaker_xgboost_container_tpu.training.callbacks import get_callbacks
+
+    monkeypatch.setenv(consensus.CONSENSUS_EVERY_ENV, "1")
+    _xgb, _it, callbacks = get_callbacks(
+        model_dir=str(tmp_path / "model"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        early_stopping_data_name=None,
+        early_stopping_metric=None,
+        early_stopping_rounds=None,
+        save_model_on_termination="false",
+        is_master=True,
+        num_round=3,
+        train_cfg={"objective": "reg:squarederror"},
+    )
+    try:
+        kinds = [
+            type(getattr(cb, "inner", cb)).__name__ for cb in callbacks
+        ]
+        assert kinds.index("ConsensusGuard") < kinds.index("SaveCheckpointCallBack"), kinds
+    finally:
+        for cb in callbacks:
+            if hasattr(cb, "after_training"):
+                cb.after_training(_JsonModel())
+
+
+def test_intermediate_save_removes_stale_sidecar(tmp_path):
+    """Manifest-less saves (the per-round intermediate model overwrite)
+    must clear any stale sidecar for the name: a manifest from a previous
+    completed run describing different bytes would make serving reject the
+    fresh spot-interruption model."""
+    _atomic_save(_JsonModel("run-1-final"), str(tmp_path), "xgboost-model",
+                 fingerprint={"objective": "reg:squarederror"})
+    assert (tmp_path / ("xgboost-model" + MANIFEST_SUFFIX)).exists()
+    # run 2's intermediate overwrite: no iteration/fingerprint -> no manifest
+    _atomic_save(_JsonModel("run-2-round-0"), str(tmp_path), "xgboost-model")
+    assert not (tmp_path / ("xgboost-model" + MANIFEST_SUFFIX)).exists()
+    assert integrity.check_model_file(str(tmp_path / "xgboost-model")) == "no_manifest"
+
+
+# --------------------------------------------------------- resume validation
+
+
+def test_validate_resume_warns_on_fingerprint_mismatch(tmp_path, caplog):
+    _atomic_save(
+        _JsonModel(), str(tmp_path), "xgboost-checkpoint.0", iteration=0,
+        fingerprint={"objective": "binary:logistic", "max_bin": "256"},
+    )
+    path = str(tmp_path / "xgboost-checkpoint.0")
+    live = {"objective": "binary:logistic", "max_bin": "64"}
+    with caplog.at_level("WARNING"):
+        ok = integrity.validate_resume(path, live)
+    assert ok is False
+    assert any("fingerprint mismatch" in r.message for r in caplog.records)
+    assert any("max_bin" in r.message for r in caplog.records)
+
+
+def test_validate_resume_strict_refuses(tmp_path, monkeypatch):
+    _atomic_save(
+        _JsonModel(), str(tmp_path), "xgboost-checkpoint.0", iteration=0,
+        fingerprint={"objective": "reg:squarederror"},
+    )
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    with pytest.raises(exc.UserError, match="fingerprint disagrees"):
+        integrity.validate_resume(
+            str(tmp_path / "xgboost-checkpoint.0"),
+            {"objective": "binary:logistic"},
+        )
+
+
+def test_validate_resume_passes_matching_and_manifestless(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    fp = {"objective": "reg:squarederror", "max_depth": "6"}
+    _atomic_save(
+        _JsonModel(), str(tmp_path), "xgboost-checkpoint.0", iteration=0,
+        fingerprint=fp,
+    )
+    assert integrity.validate_resume(
+        str(tmp_path / "xgboost-checkpoint.0"), dict(fp)
+    ) is True
+    # manifest-less (older runs): nothing to compare, passes even strict
+    bare = tmp_path / "xgboost-checkpoint.1"
+    bare.write_text("{}")
+    assert integrity.validate_resume(str(bare), fp) is True
+
+
+def test_get_callbacks_stamps_fingerprint_into_checkpoints(tmp_path):
+    from sagemaker_xgboost_container_tpu.training.callbacks import get_callbacks
+
+    _xgb, _it, callbacks = get_callbacks(
+        model_dir=str(tmp_path / "model"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        early_stopping_data_name=None,
+        early_stopping_metric=None,
+        early_stopping_rounds=None,
+        save_model_on_termination="false",
+        is_master=True,
+        num_round=3,
+        train_cfg={"objective": "binary:logistic", "max_depth": 2},
+    )
+    model = _JsonModel()
+    try:
+        for cb in callbacks:
+            if hasattr(cb, "before_training"):
+                cb.before_training(model)
+        for cb in callbacks:
+            if hasattr(cb, "after_iteration"):
+                cb.after_iteration(model, 0, {})
+    finally:
+        for cb in callbacks:
+            if hasattr(cb, "after_training"):
+                cb.after_training(model)
+    manifest = integrity.read_manifest(str(tmp_path / "ckpt" / "xgboost-checkpoint.0"))
+    assert manifest is not None
+    assert manifest["fingerprint"]["objective"] == "binary:logistic"
+    assert manifest["fingerprint"]["max_depth"] == "2"
+    assert "jax_version" in manifest["fingerprint"]
+
+
+# ----------------------------------------------------------- forest digests
+
+
+def test_forest_digest_deterministic_and_bit_sensitive():
+    forest = _tiny_forest()
+    d1 = integrity.forest_digest(forest)
+    assert d1 == integrity.forest_digest(forest)
+    import copy
+
+    forked = copy.deepcopy(forest)
+    assert integrity.forest_digest(forked) == d1
+    forked.trees[0].threshold.view(np.uint32)[0] ^= np.uint32(1)
+    assert integrity.forest_digest(forked) != d1
+
+
+def test_forest_digest_covers_gblinear_and_categories():
+    """The digest must cover every model family the guard can ride on:
+    gblinear commits weights/bias (no trees), and BYO/refreshed categorical
+    models route splits by per-node category sets."""
+    from sagemaker_xgboost_container_tpu.models.forest import Forest, Tree
+    from sagemaker_xgboost_container_tpu.models.gblinear import LinearModel
+
+    lin = LinearModel(np.ones((3, 1)), np.zeros(1), "reg:squarederror", 0.5, 3)
+    d_lin = integrity.forest_digest(lin)
+    assert d_lin == integrity.forest_digest(lin)
+    lin2 = LinearModel(np.ones((3, 1)), np.zeros(1), "reg:squarederror", 0.5, 3)
+    lin2.weights[0] += np.float32(1e-7)
+    assert integrity.forest_digest(lin2) != d_lin
+
+    def cat_forest(cats):
+        tree = Tree(
+            feature=[0, 0, 0], threshold=[0.0, 0.0, 0.0],
+            default_left=[True, False, False], left=[1, -1, -1],
+            right=[2, -1, -1], value=[0.0, -1.0, 1.0],
+            categories={0: cats},
+        )
+        f = Forest(num_feature=1)
+        f.append_round([tree], [0])
+        return f
+
+    assert integrity.forest_digest(cat_forest([2, 5])) != integrity.forest_digest(
+        cat_forest([2, 6])
+    )
+
+
+def test_consensus_guard_rides_gblinear_without_crashing():
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(1)
+    X = rng.rand(64, 3).astype(np.float32)
+    y = (X @ np.asarray([2.0, 1.0, 0.5], np.float32)).astype(np.float32)
+    guard = consensus.ConsensusGuard(every=1)
+    train(
+        {"booster": "gblinear", "objective": "reg:squarederror"},
+        DataMatrix(X, labels=y),
+        num_boost_round=3,
+        callbacks=[guard],
+    )
+    assert guard.checks == 3 and guard.divergences == 0
+
+
+def test_resave_over_rejected_checkpoint_never_leaves_stale_manifest(tmp_path):
+    """Resume re-writes a rejected iteration over the same name: the new
+    bytes must verify against the new sidecar (the stale one is dropped
+    before the rename, so no window leaves new bytes + old manifest)."""
+    _atomic_save(_JsonModel("v1"), str(tmp_path), "xgboost-checkpoint.3", iteration=3)
+    _atomic_save(_JsonModel("v2-different-bytes"), str(tmp_path),
+                 "xgboost-checkpoint.3", iteration=3)
+    path = str(tmp_path / "xgboost-checkpoint.3")
+    assert integrity.check_model_file(path) == "verified"
+    assert _checkpoint_usable(path) is True
+
+
+def test_consensus_enabled_leaves_committed_trees_unchanged():
+    """Acceptance: with the guard riding the callback stack and no faults,
+    committed trees are bitwise identical to a guard-less run (the digest
+    work is host-side observation only)."""
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(128, 5).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3}
+    guard = consensus.ConsensusGuard(every=1)
+    f_guarded = train(
+        dict(params), DataMatrix(X, labels=y), num_boost_round=3, callbacks=[guard]
+    )
+    f_plain = train(dict(params), DataMatrix(X, labels=y), num_boost_round=3)
+    assert guard.checks == 3 and guard.divergences == 0
+    assert integrity.forest_digest(f_guarded) == integrity.forest_digest(f_plain)
+
+
+# ----------------------------------------------------------- consensus guard
+
+
+def test_consensus_guard_cadence_and_match(capsys):
+    forest = _tiny_forest()
+    calls = []
+    guard = consensus.ConsensusGuard(
+        every=2,
+        exchange=lambda digest, rnd: calls.append(rnd) or [digest, digest],
+        abort_fn=lambda *a, **k: pytest.fail("matching digests must not abort"),
+    )
+    for epoch in range(6):
+        assert guard.after_iteration(forest, epoch, {}) is False
+    assert calls == [1, 3, 5]  # every 2nd committed round
+    assert guard.checks == 3 and guard.divergences == 0
+
+
+def test_consensus_guard_divergence_emits_record_and_aborts(capsys):
+    forest = _tiny_forest()
+    aborts = []
+    guard = consensus.ConsensusGuard(
+        every=1,
+        exchange=lambda digest, rnd: [digest, "f" * 64],
+        abort_fn=lambda reason, code, **fields: aborts.append((reason, code, fields)),
+    )
+    before = _counter_value("consensus_divergence_total")
+    guard.after_iteration(forest, 0, {})
+    assert aborts and aborts[0][0] == "consensus_divergence"
+    assert aborts[0][1] == EXIT_CONSENSUS_DIVERGENCE == 81
+    assert _counter_value("consensus_divergence_total") == before + 1
+    records = [
+        json.loads(l)
+        for l in capsys.readouterr().out.splitlines()
+        if l.startswith('{"metric": "training.divergence"')
+    ]
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["round"] == 0 and rec["world_size"] == 1
+    assert rec["digests"]["1"] == "f" * 64
+    assert rec["digests"]["0"] == integrity.forest_digest(forest)
+
+
+def test_consensus_fault_point_perturbs_local_digest():
+    forest = _tiny_forest()
+    aborts = []
+    seen = []
+    faults.configure("consensus.check:error@2")
+    guard = consensus.ConsensusGuard(
+        every=1,
+        exchange=lambda digest, rnd: seen.append(digest) or [digest],
+        abort_fn=lambda reason, code, **f: aborts.append(code),
+    )
+    guard.after_iteration(forest, 0, {})
+    guard.after_iteration(forest, 1, {})  # 2nd hit: digest perturbed
+    assert seen[0] == integrity.forest_digest(forest)
+    assert seen[1] != seen[0] and seen[1].startswith("f" * 8)
+    # world size 1: a lone perturbed digest agrees with itself, no abort —
+    # divergence is a CROSS-rank verdict
+    assert aborts == []
+
+
+def test_consensus_mixed_round_exchange_skips_not_aborts(caplog):
+    """A check-index misalignment (one rank skipped a timed-out exchange,
+    so the allgather mixed two check rounds) must be skipped as a transport
+    pathology — forests from different rounds necessarily differ, and
+    treating that as divergence would abort a healthy cluster."""
+    forest = _tiny_forest()
+    guard = consensus.ConsensusGuard(
+        every=1,
+        exchange=lambda digest, rnd: [
+            {"digest": digest, "round": rnd},
+            {"digest": "f" * 64, "round": rnd + 1},  # peer is one check ahead
+        ],
+        abort_fn=lambda *a, **k: pytest.fail("mixed rounds must not abort"),
+    )
+    with caplog.at_level("WARNING"):
+        assert guard.after_iteration(forest, 3, {}) is False
+    assert any("mixed check rounds" in r.message for r in caplog.records)
+    assert guard.divergences == 0
+    # same-round dict replies with a real mismatch still trip the guard
+    aborts = []
+    guard2 = consensus.ConsensusGuard(
+        every=1,
+        exchange=lambda digest, rnd: [
+            {"digest": digest, "round": rnd},
+            {"digest": "f" * 64, "round": rnd},
+        ],
+        abort_fn=lambda reason, code, **f: aborts.append(code),
+    )
+    guard2.after_iteration(forest, 3, {})
+    assert aborts == [EXIT_CONSENSUS_DIVERGENCE]
+
+
+def test_consensus_exchange_failure_skips_check_not_abort(caplog):
+    forest = _tiny_forest()
+
+    def broken_exchange(digest, rnd):
+        raise exc.PlatformError("peer unreachable")
+
+    guard = consensus.ConsensusGuard(
+        every=1,
+        exchange=broken_exchange,
+        abort_fn=lambda *a, **k: pytest.fail("transport blip must not abort"),
+    )
+    with caplog.at_level("WARNING"):
+        assert guard.after_iteration(forest, 0, {}) is False
+    assert any("exchange failed" in r.message for r in caplog.records)
+
+
+def test_consensus_cluster_exchange_over_real_sockets():
+    """Two ranks allgather digests through the real framed-TCP exchange on
+    the dedicated consensus port (loopback master override)."""
+    port = free_port()
+    hosts = ["algo-1", "algo-2"]
+    results = {}
+
+    def run(rank):
+        exchange = consensus.cluster_exchange(
+            hosts, hosts[rank], port=port, timeout=10.0, master_addr="127.0.0.1"
+        )
+        results[rank] = exchange("digest-{}".format(rank), 4)
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    threads[0].start()
+    time.sleep(0.2)  # let the master bind first
+    threads[1].start()
+    for t in threads:
+        t.join(timeout=20)
+    expected = [
+        {"digest": "digest-0", "round": 4},
+        {"digest": "digest-1", "round": 4},
+    ]
+    assert results[0] == results[1] == expected
+
+
+def test_maybe_consensus_guard_env_gate(monkeypatch):
+    monkeypatch.delenv(consensus.CONSENSUS_EVERY_ENV, raising=False)
+    assert consensus.maybe_consensus_guard() is None
+    monkeypatch.setenv(consensus.CONSENSUS_EVERY_ENV, "0")
+    assert consensus.maybe_consensus_guard() is None
+    monkeypatch.setenv(consensus.CONSENSUS_EVERY_ENV, "5")
+    guard = consensus.maybe_consensus_guard()
+    assert guard is not None and guard.every == 5 and guard.world_size == 1
+    consensus.register_cluster(["algo-2", "algo-1"], "algo-2")
+    guard = consensus.maybe_consensus_guard()
+    assert guard.world_size == 2 and guard.rank == 1  # sorted hosts
+
+
+# ----------------------------------------------- subprocess divergence drill
+
+_DRILL_SCRIPT = r"""
+import json, os, sys
+rank, port, n_ranks = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+import numpy as np
+from sagemaker_xgboost_container_tpu.models.forest import Forest, Tree
+from sagemaker_xgboost_container_tpu.training import consensus
+
+# identical hand-built forest on every rank (no training, no device work)
+tree = Tree(
+    feature=[0, 0, 0], threshold=[0.5, 0.0, 0.0], default_left=[True, False, False],
+    left=[1, -1, -1], right=[2, -1, -1], value=[0.0, -1.0, 1.0],
+)
+forest = Forest(num_feature=1)
+forest.append_round([tree], [0])
+
+hosts = ["algo-{}".format(i + 1) for i in range(n_ranks)]
+guard = consensus.ConsensusGuard(
+    every=1, hosts=hosts, current_host=hosts[rank], port=port,
+    timeout=30.0, master_addr="127.0.0.1",
+)
+guard.after_iteration(forest, 0, {})   # divergence -> request_abort -> exit 81
+os._exit(0)                            # only reached when NO divergence
+"""
+
+
+def test_subprocess_drill_single_rank_fault_drives_all_ranks_to_exit_81(tmp_path):
+    """Acceptance drill: an injected ``consensus.check`` fault on ONE rank
+    is detected within one consensus interval and EVERY rank exits 81 with
+    the per-rank digests in its ``training.divergence`` record."""
+    script = tmp_path / "drill.py"
+    script.write_text(_DRILL_SCRIPT)
+    port = free_port()
+    n_ranks = 2
+    env_base = dict(os.environ)
+    env_base.pop("SM_FAULT_SPEC", None)
+    env_base.update({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "", "PYTHONPATH": REPO})
+    procs = []
+    for rank in range(n_ranks):
+        env = dict(env_base)
+        if rank == 1:
+            env["SM_FAULT_SPEC"] = "consensus.check:error:injected divergence"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script), str(rank), str(port), str(n_ranks)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for rank, proc in enumerate(procs):
+        out, err = proc.communicate(timeout=120)
+        outs.append(out)
+        assert proc.returncode == EXIT_CONSENSUS_DIVERGENCE, (
+            rank, proc.returncode, out[-2000:], err[-2000:],
+        )
+    for rank, out in enumerate(outs):
+        records = [
+            json.loads(l)
+            for l in out.splitlines()
+            if l.startswith('{"metric": "training.divergence"')
+        ]
+        assert len(records) == 1, (rank, out[-2000:])
+        digests = records[0]["digests"]
+        assert len(digests) == n_ranks
+        assert digests["0"] != digests["1"], "rank 1's digest must be perturbed"
+        aborts = [
+            json.loads(l)
+            for l in out.splitlines()
+            if l.startswith('{"metric": "training.abort"')
+        ]
+        assert aborts and aborts[0]["reason"] == "consensus_divergence"
+        assert aborts[0]["exit_code"] == EXIT_CONSENSUS_DIVERGENCE
+
+
+# --------------------------------------------------- verified serving loads
+
+
+def _write_valid_model(model_dir, with_manifest=False):
+    os.makedirs(str(model_dir), exist_ok=True)
+    forest = _tiny_forest()
+    path = os.path.join(str(model_dir), "xgboost-model")
+    forest.save_model(path)
+    if with_manifest:
+        integrity.write_manifest(path)
+    return path, forest
+
+
+def _status_of(app, path="/ping", method="GET", body=b"", content_type="text/csv"):
+    captured = {}
+
+    def start_response(status, headers, exc_info=None):
+        captured["status"] = status
+        captured["headers"] = dict(headers)
+
+    environ = {
+        "PATH_INFO": path,
+        "REQUEST_METHOD": method,
+        "CONTENT_TYPE": content_type,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+    }
+    resp = b"".join(app(environ, start_response))
+    return int(captured["status"].split()[0]), resp
+
+
+def test_serving_rejects_truncated_model_with_5xx(tmp_path):
+    path, _ = _write_valid_model(tmp_path)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    before = _counter_value("model_verify_fail_total", {"stage": "parse"})
+    app = make_app(ScoringService(model_dir=str(tmp_path)))
+    status, body = _status_of(app, "/ping")
+    assert status == 500, body
+    assert _counter_value("model_verify_fail_total", {"stage": "parse"}) == before + 1
+
+
+def test_serving_rejects_digest_mismatch_with_5xx(tmp_path):
+    path, _ = _write_valid_model(tmp_path, with_manifest=True)
+    # bit-flip INSIDE valid JSON (a quote-safe char) so only the digest can
+    # catch it — the parse would happily load the altered model
+    raw = bytearray(open(path, "rb").read())
+    idx = raw.index(b"5")
+    raw[idx] = ord("6")
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    before = _counter_value("model_verify_fail_total", {"stage": "digest"})
+    app = make_app(ScoringService(model_dir=str(tmp_path)))
+    status, body = _status_of(app, "/ping")
+    assert status == 500
+    assert b"digest" in body
+    assert _counter_value("model_verify_fail_total", {"stage": "digest"}) == before + 1
+
+
+def test_serving_rejects_structurally_invalid_model_with_5xx(tmp_path):
+    path, forest = _write_valid_model(tmp_path)
+    doc = json.loads(open(path).read())
+    trees = doc["learner"]["gradient_booster"]["model"]["trees"]
+    trees[0]["left_children"][0] = 10 ** 6  # child index far out of range
+    with open(path, "w") as f:
+        f.write(json.dumps(doc))
+    before = _counter_value("model_verify_fail_total", {"stage": "structure"})
+    app = make_app(ScoringService(model_dir=str(tmp_path)))
+    status, body = _status_of(app, "/ping")
+    assert status == 500
+    assert b"structurally invalid" in body
+    assert (
+        _counter_value("model_verify_fail_total", {"stage": "structure"}) == before + 1
+    )
+
+
+def test_serving_accepts_verified_model_and_predicts(tmp_path):
+    path, forest = _write_valid_model(tmp_path, with_manifest=True)
+    app = make_app(ScoringService(model_dir=str(tmp_path)))
+    status, _ = _status_of(app, "/ping")
+    assert status == 200
+    payload = b"0.1,0.2,0.3,0.4"
+    status, body = _status_of(
+        app, "/invocations", method="POST", body=payload, content_type="text/csv"
+    )
+    assert status == 200, body
+
+
+def test_manifest_sidecar_not_loaded_as_ensemble_member(tmp_path, monkeypatch):
+    _write_valid_model(tmp_path, with_manifest=True)
+    monkeypatch.setenv("SAGEMAKER_INFERENCE_ENSEMBLE", "true")
+    model, fmt = serve_utils.get_loaded_booster(str(tmp_path), ensemble=True)
+    # one model + one sidecar in the dir -> a single loaded model, not a
+    # failed attempt to parse the manifest as a model
+    assert not isinstance(model, list)
+
+
+def test_model_load_fault_point_drillable(tmp_path):
+    _write_valid_model(tmp_path)
+    faults.configure("model.load:error:injected load fault")
+    app = make_app(ScoringService(model_dir=str(tmp_path)))
+    status, body = _status_of(app, "/ping")
+    assert status == 500
+    assert faults.fault_counts().get("model.load") == 1
+
+
+def test_mme_load_of_corrupt_model_returns_5xx(tmp_path):
+    from sagemaker_xgboost_container_tpu.serving.mme import make_mme_app
+
+    model_dir = tmp_path / "m1"
+    path, _ = _write_valid_model(model_dir)
+    with open(path, "w") as f:
+        f.write("{definitely not a model")
+    app = make_mme_app()
+    body = json.dumps({"model_name": "m1", "url": str(model_dir)}).encode()
+    status, resp = _status_of(
+        app, "/models", method="POST", body=body, content_type="application/json"
+    )
+    assert status == 500, resp
+
+
+def test_validate_model_catalogue():
+    """Structural validator: each invariant violation is caught and named."""
+    forest = _tiny_forest()
+    integrity.validate_model(forest)  # healthy model passes
+
+    def forked(mutate):
+        import copy
+
+        f = copy.deepcopy(forest)
+        mutate(f)
+        return f
+
+    cases = [
+        (lambda f: f.trees[0].left.__setitem__(0, 99), "out of range"),
+        (lambda f: f.trees[0].threshold.__setitem__(0, np.nan), "non-finite"),
+        (lambda f: f.tree_info.pop(), "tree_info"),
+        (lambda f: f.iteration_indptr.__setitem__(-1, 99), "iteration_indptr"),
+        (lambda f: f.trees[0].feature.__setitem__(0, 77), "num_feature"),
+    ]
+    for mutate, needle in cases:
+        with pytest.raises(integrity.IntegrityError, match=needle):
+            integrity.validate_model(forked(mutate))
+    # non-finite leaf: find a leaf node and poison its value
+    bad = forked(lambda f: None)
+    leaf = int(np.nonzero(bad.trees[0].left < 0)[0][0])
+    bad.trees[0].value[leaf] = np.inf
+    with pytest.raises(integrity.IntegrityError, match="leaf value"):
+        integrity.validate_model(bad)

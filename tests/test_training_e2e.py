@@ -120,9 +120,13 @@ def test_kfold_cv_end_to_end(tmp_path):
     )
     result = _run_train(env)
     assert result.returncode == 0, result.stderr[-3000:]
-    # k*r = 6 models
-    models = sorted(p.name for p in model_dir.iterdir())
-    assert models == ["xgboost-model-{}".format(i) for i in range(6)], models
+    # k*r = 6 models, each with its integrity manifest sidecar
+    names = sorted(p.name for p in model_dir.iterdir())
+    models = [n for n in names if not n.endswith(".manifest")]
+    assert models == ["xgboost-model-{}".format(i) for i in range(6)], names
+    assert sorted(n for n in names if n.endswith(".manifest")) == [
+        "xgboost-model-{}.manifest".format(i) for i in range(6)
+    ], names
     preds = np.loadtxt(str(output_dir / "predictions.csv"), delimiter=",")
     assert preds.shape[1] == 2  # y_true, mean prediction
 
@@ -143,10 +147,14 @@ def test_checkpoint_resume(tmp_path):
     ckpt_conf.write_text(json.dumps(conf_extra))
     result = _run_train(env)
     assert result.returncode == 0, result.stderr[-3000:]
-    ckpts = sorted(os.listdir(ckpt_dir))
-    # max_to_keep = 5 retention
-    assert len(ckpts) == 5, ckpts
+    names = sorted(os.listdir(ckpt_dir))
+    ckpts = [n for n in names if not n.endswith(".manifest")]
+    # max_to_keep = 5 retention, each checkpoint with its manifest sidecar
+    assert len(ckpts) == 5, names
     assert "xgboost-checkpoint.7" in ckpts
+    assert sorted(n + ".manifest" for n in ckpts) == [
+        n for n in names if n.endswith(".manifest")
+    ], names
 
     # resume: delete the last checkpoints, rerun — should continue, not restart
     for name in ("xgboost-checkpoint.6", "xgboost-checkpoint.7"):

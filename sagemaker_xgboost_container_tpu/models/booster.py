@@ -31,7 +31,12 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..data.binning import bin_matrix
-from ..ops.histogram import hist_comm_impl, padded_feature_width, round_comm_plan
+from ..ops.histogram import (
+    hist_comm_impl,
+    padded_feature_width,
+    resolve_hist_knobs,
+    round_comm_plan,
+)
 from ..ops.ranking import build_group_layout, lambdarank_grad_hess
 from ..ops.tree_build import (
     build_tree,
@@ -215,24 +220,30 @@ def _merged_distributed_cuts(dtrain, max_bin, weights=None):
     return merged
 
 
-def _apply_packed_tree(packed, bins, margins, num_group, num_parallel, depth, num_bins):
-    """margins += the packed tree's (or tree stack's) outputs on ``bins``."""
+def _apply_packed_tree(packed, bins, margins, num_group, num_parallel, depth,
+                       num_bins, route_impl=None):
+    """margins += the packed tree's (or tree stack's) outputs on ``bins``.
+
+    Runs under trace (the round fn and the session apply fn), so the
+    routing knob must arrive as ``route_impl`` — the session's
+    ``hist_knobs.route_impl`` snapshot, never a trace-time env read.
+    """
     tree = tree_from_packed(packed)
+
+    def one(t):
+        return predict_binned(t, bins, depth, num_bins, route_impl=route_impl)
+
     if num_group == 1:
         if num_parallel > 1:
-            delta = jax.vmap(lambda t: predict_binned(t, bins, depth, num_bins))(
-                tree
-            ).sum(axis=0)
+            delta = jax.vmap(one)(tree).sum(axis=0)
         else:
-            delta = predict_binned(tree, bins, depth, num_bins)
+            delta = one(tree)
         return margins + delta
     if num_parallel > 1:
         # packed [P, C, ...]: sum the bagged parallel trees per class
-        deltas = jax.vmap(
-            jax.vmap(lambda t: predict_binned(t, bins, depth, num_bins))
-        )(tree).sum(axis=0)
+        deltas = jax.vmap(jax.vmap(one))(tree).sum(axis=0)
     else:
-        deltas = jax.vmap(lambda t: predict_binned(t, bins, depth, num_bins))(tree)
+        deltas = jax.vmap(one)(tree)
     return margins + deltas.T
 
 
@@ -269,6 +280,10 @@ class _TrainingSession:
         # session, new round-fn closure, hence its own jit cache entry)
         # picks up the new value.
         self.hist_comm = hist_comm_impl() if mesh is not None else "psum"
+        # every other histogram/scan/routing knob, snapshotted host-side for
+        # the same reason (trace-safety: graftlint trace-env-read forbids
+        # env reads in the traced build path) and threaded into the builders
+        self.hist_knobs = resolve_hist_knobs()
         if self.hist_comm == "reduce_scatter" and self.has_feature_axis:
             # reduce_scatter re-shards the SPLIT SCAN over the data axis;
             # with a feature axis the scan is already column-sharded and the
@@ -669,6 +684,7 @@ class _TrainingSession:
             d_global=self.train_binned.num_col,
             hist_comm=self.hist_comm,
             n_data_shards=self.n_data_shards,
+            knobs=self.hist_knobs,
         )
         if cfg.grow_policy == "lossguide":
             from ..ops.lossguide import build_tree_lossguide
@@ -835,6 +851,7 @@ class _TrainingSession:
                             m_e = _apply_packed_tree(
                                 packed, b_e, extra[ei],
                                 num_group, num_parallel, predict_depth, num_bins,
+                                route_impl=self.hist_knobs.route_impl,
                             )
                             new_extra.append(m_e)
                             ei += 1
@@ -881,7 +898,9 @@ class _TrainingSession:
         fn = multi_round if use_scan else one_round
         if self.mesh is None:
             if not use_scan:
+                # graftlint: disable=trace-uncached-jit — session-scope construction: built once per training session, not per call (one session = one round closure = its own jit cache)
                 return jax.jit(fn, donate_argnums=(1,))
+            # graftlint: disable=trace-uncached-jit — session-scope construction: built once per training session, not per call (one session = one round closure = its own jit cache)
             return jax.jit(fn, donate_argnums=(1, 9))
 
         margin_spec = P("data") if num_group == 1 else P("data", None)
@@ -922,6 +941,7 @@ class _TrainingSession:
             out_specs=out_specs,
             **_SHARD_MAP_REP_KW,
         )
+        # graftlint: disable=trace-uncached-jit — session-scope construction: built once per training session, not per call (one session = one round closure = its own jit cache)
         return jax.jit(mapped, donate_argnums=donate)
 
     def _make_apply_fn(self):
@@ -930,13 +950,16 @@ class _TrainingSession:
         num_group = self.num_group
         num_parallel = cfg.num_parallel_tree
 
+        route_impl = self.hist_knobs.route_impl
+
         def apply_tree(packed, bins, margins):
             return _apply_packed_tree(
                 packed, bins, margins, num_group, num_parallel,
-                cfg.predict_depth, num_bins,
+                cfg.predict_depth, num_bins, route_impl=route_impl,
             )
 
         if self.mesh is None:
+            # graftlint: disable=trace-uncached-jit — session-scope construction: _make_apply_fn runs once per session
             return jax.jit(apply_tree, donate_argnums=(2,))
         margin_spec = P("data") if num_group == 1 else P("data", None)
         mapped = shard_map(
@@ -946,6 +969,7 @@ class _TrainingSession:
             out_specs=margin_spec,
             **_SHARD_MAP_REP_KW,
         )
+        # graftlint: disable=trace-uncached-jit — session-scope construction: _make_apply_fn runs once per session
         return jax.jit(mapped, donate_argnums=(2,))
 
     # ----------------------------------------------------------- comm stats
@@ -967,11 +991,15 @@ class _TrainingSession:
         if cfg.grow_policy == "lossguide":
             from ..ops.lossguide import _subtraction_enabled
 
-            subtract = _subtraction_enabled(cfg.max_leaves, d_local, num_bins)
+            subtract = _subtraction_enabled(
+                cfg.max_leaves, d_local, num_bins, knobs=self.hist_knobs
+            )
         else:
             from ..ops.tree_build import _subtraction_enabled
 
-            subtract = _subtraction_enabled(cfg.max_depth, d_local, num_bins)
+            subtract = _subtraction_enabled(
+                cfg.max_depth, d_local, num_bins, knobs=self.hist_knobs
+            )
         return round_comm_plan(
             cfg.grow_policy,
             cfg.max_depth,
@@ -1034,6 +1062,7 @@ class _TrainingSession:
                         fn, out_spec = scatter_fn, P(None, "data", None)
                     else:
                         fn, out_spec = psum_fn, P()
+                    # graftlint: disable=trace-uncached-jit — calibration-scope: one standalone collective timing per distinct payload shape per session, off the round path
                     mapped = jax.jit(
                         shard_map(
                             fn,
@@ -1140,6 +1169,7 @@ class _TrainingSession:
         )
 
         if self._grad_fn is None:
+            # graftlint: disable=trace-uncached-jit — memoized on self._grad_fn: constructed once per session
             self._grad_fn = jax.jit(self.objective.grad_hess)
         _g, h = self._grad_fn(self.margins, self.labels, self.weights)
         if h.ndim == 2:  # multi-class: sketch weight = summed class hessians

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.binning import bin_matrix
+from ..ops.histogram import resolve_hist_knobs
 from ..ops.tree_build import build_tree, pack_tree, unpack_tree
 from .device_metrics import all_supported
 from .forest import Forest, compact_padded_tree
@@ -122,6 +123,10 @@ def train_cv_parallel(
 
     k_rounds = max(1, cfg.rounds_per_dispatch)
 
+    # knob snapshot for the traced build (trace-safety: no env reads under
+    # trace) — resolved here, host-side, once per CV dispatch program
+    hist_knobs = resolve_hist_knobs()
+
     def fold_round(bins, margins_k, tw_k, vw_k, rng_k):
         g, h = grad_hess(margins_k, labels_dev, tw_k)
         if cfg.subsample < 1.0:
@@ -152,6 +157,7 @@ def train_cv_parallel(
             colsample_bylevel=cfg.colsample_bylevel,
             colsample_bynode=cfg.colsample_bynode,
             interaction_sets=interaction_sets,
+            knobs=hist_knobs,
         )
         margins_k = margins_k + row_out
         stats = []
@@ -177,6 +183,7 @@ def train_cv_parallel(
         )
         return margins, packed_all, stats_all
 
+    # graftlint: disable=trace-uncached-jit — session-scope construction: one CV dispatch program per train call
     dispatch_jit = jax.jit(dispatch, donate_argnums=(0,))
 
     rng = jax.random.PRNGKey(cfg.seed)

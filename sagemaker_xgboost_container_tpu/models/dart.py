@@ -110,6 +110,8 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
         row_spec = P("data") if nclass == 1 else P("data", None)
         jit_kwargs["out_shardings"] = (tree_spec, NamedSharding(mesh, row_spec))
 
+    hist_knobs = session.hist_knobs  # the session's host-side knob snapshot (trace-safety)
+
     def _build_one(bins, g, h, num_cuts, mask, rng):
         return build_tree(
             bins, g, h, num_cuts,
@@ -124,6 +126,7 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             feature_mask=mask,
             colsample_bylevel=config.colsample_bylevel,
             rng=rng,
+            knobs=hist_knobs,
         )
 
     if nclass > 1:
@@ -136,7 +139,9 @@ def train_dart(config, forest, dtrain, evals, feval, callbacks, num_boost_round,
             return tree, row_out.T
     else:
         _build = _build_one
+    # graftlint: disable=trace-uncached-jit — session-scope construction: one builder per train_dart call
     builder = jax.jit(_build, **jit_kwargs)
+    # graftlint: disable=trace-uncached-jit — session-scope construction: one grad fn per train_dart call
     grad_fn = jax.jit(session.objective.grad_hess)
 
     tree_contribs = []   # device [n] ([n, C] multi-class) contributions, current scaling

@@ -319,6 +319,7 @@ def train_linear(
             rep_kw = {"check_rep": False}  # pre-0.6 kwarg name
 
         lab_spec = P("data") if labels.ndim == 1 else P("data", None)
+        # graftlint: disable=trace-uncached-jit — session-scope construction: one linear round program per train call
         one_round_sharded = jax.jit(
             shard_map(
                 _round_body,

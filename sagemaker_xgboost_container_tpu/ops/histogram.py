@@ -26,11 +26,60 @@ hardware without code changes:
   accuracy, accumulated in f32.
 """
 
+import collections
 import functools
 import os
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.envconfig import env_int
+
+# Session-build-time snapshot of every histogram/scan/routing tuning knob.
+# Trace-safety contract (graftlint trace-env-read, docs/static-analysis.md):
+# the jitted round path must not read env — the training session resolves
+# one HistKnobs via resolve_hist_knobs() when it builds the round closure
+# (the PR-4 GRAFT_HIST_COMM pattern) and threads it through the builders.
+# The per-knob env fallbacks below remain the documented API for DIRECT
+# callers only (unit tests, bench probes A/B-ing a single kernel).
+HistKnobs = collections.namedtuple(
+    "HistKnobs",
+    [
+        "impl",          # GRAFT_HIST_IMPL (backend-aware default)
+        "totals_impl",   # GRAFT_TOTALS_IMPL (backend-aware default)
+        "route_impl",    # GRAFT_ROUTE_IMPL (ops/tree_build.row_bin_lookup)
+        "matmul_chunk",  # GRAFT_HIST_CHUNK
+        "pallas_block",  # GRAFT_HIST_BLOCK
+        "precision",     # GRAFT_HIST_MM_PREC
+        "align",         # GRAFT_HIST_ALIGN
+        "vnodes",        # GRAFT_HIST_VNODES
+        "vnode_vmem",    # GRAFT_VNODE_VMEM
+        "subtract",      # GRAFT_HIST_SUBTRACT
+        "subtract_mem",  # GRAFT_SUBTRACT_MEM
+    ],
+)
+
+
+def resolve_hist_knobs():
+    """Resolve every histogram-path knob from env ONCE, host-side.
+
+    Call at session build time (models/booster.py), never from code that
+    can run under trace: the snapshot is what keeps every shard — and
+    every re-trace — seeing identical knob values for the session's life.
+    """
+    return HistKnobs(
+        impl=_impl(),
+        totals_impl=_totals_impl(),
+        route_impl=os.environ.get("GRAFT_ROUTE_IMPL", "gather"),
+        matmul_chunk=_matmul_chunk(),
+        pallas_block=_pallas_block(),
+        precision=_matmul_precision(),
+        align=os.environ.get("GRAFT_HIST_ALIGN", "1") == "1",
+        vnodes=os.environ.get("GRAFT_HIST_VNODES", "1") == "1",
+        vnode_vmem=env_int("GRAFT_VNODE_VMEM", 4 * 1024 * 1024, minimum=0),
+        subtract=os.environ.get("GRAFT_HIST_SUBTRACT", "1") == "1",
+        subtract_mem=env_int("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024, minimum=0),
+    )
 
 
 def _impl():
@@ -38,34 +87,54 @@ def _impl():
     measured TPU winner (BASELINE.md round-2 probes: pallas 3.15 r/s vs
     flat 0.265 on the bench config); the flat segment-sum wins on CPU.
     GRAFT_HIST_IMPL overrides either way."""
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot this via resolve_hist_knobs() at build time
     v = os.environ.get("GRAFT_HIST_IMPL")
     if v:
         return v
     return "pallas" if jax.default_backend() == "tpu" else "flat"
 
 
+def _totals_impl():
+    """Backend-aware GRAFT_TOTALS_IMPL default (see node_totals)."""
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot this via resolve_hist_knobs() at build time
+    impl = os.environ.get("GRAFT_TOTALS_IMPL")
+    if not impl:
+        impl = "onehot" if jax.default_backend() == "tpu" else "segment"
+    return impl
+
+
 def _matmul_chunk():
-    return int(os.environ.get("GRAFT_HIST_CHUNK", 65536))
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot this via resolve_hist_knobs() at build time
+    return env_int("GRAFT_HIST_CHUNK", 65536, minimum=1)
 
 
-def _balanced_chunks(n):
+def _balanced_chunks(n, chunk_rows=None):
     """(chunk, steps) for scanning n rows in ~GRAFT_HIST_CHUNK-row chunks.
 
     Balanced: caps padding waste at steps-1 rows instead of a nearly full
     chunk when n slightly exceeds a multiple of the configured size.
     Requires n >= 1.
     """
-    steps_wanted = -(-n // min(_matmul_chunk(), n))
+    if chunk_rows is None:
+        chunk_rows = _matmul_chunk()
+    steps_wanted = -(-n // min(chunk_rows, n))
     chunk = -(-n // steps_wanted)
     return chunk, -(-n // chunk)
 
 
 def _pallas_block():
-    return int(os.environ.get("GRAFT_HIST_BLOCK", 512))
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot this via resolve_hist_knobs() at build time
+    return env_int("GRAFT_HIST_BLOCK", 512, minimum=1)
 
 
 def _matmul_precision():
     """f32 | bf16x2 | bf16 for matmul/pallas operand precision."""
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot this via resolve_hist_knobs() at build time
     return os.environ.get("GRAFT_HIST_MM_PREC", "bf16x2")
 
 
@@ -188,13 +257,20 @@ def round_comm_plan(
     return entries, int(total_bytes)
 
 
-def subtraction_enabled(cache_bytes):
+def subtraction_enabled(cache_bytes, knobs=None):
     """Shared gate for sibling-subtraction paths (both growers): the
     GRAFT_HIST_SUBTRACT kill-switch plus a memory cap on the histogram cache
-    the caller would have to keep alive (GRAFT_SUBTRACT_MEM, default 512MB)."""
+    the caller would have to keep alive (GRAFT_SUBTRACT_MEM, default 512MB).
+    ``knobs``: the session's :class:`HistKnobs` (env fallback for direct
+    callers)."""
+    if knobs is not None:
+        return knobs.subtract and cache_bytes <= knobs.subtract_mem
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot these via resolve_hist_knobs() at build time
     if os.environ.get("GRAFT_HIST_SUBTRACT", "1") != "1":
         return False
-    cap = int(os.environ.get("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024))
+    # graftlint: disable=trace-env-read — direct-caller fallback only
+    cap = env_int("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024, minimum=0)
     return cache_bytes <= cap
 
 
@@ -208,6 +284,7 @@ def level_histogram(
     axis_name=None,
     comm="psum",
     axis_size=1,
+    knobs=None,
 ):
     """Build (G, H) histograms for one tree level.
 
@@ -223,18 +300,23 @@ def level_histogram(
         full histograms; "reduce_scatter" psum_scatters them along the
         feature dim so each shard gets only its d/axis_size column slice.
       axis_size: static size of ``axis_name`` (required for reduce_scatter).
+      knobs: the session's :class:`HistKnobs` snapshot. None falls back to
+        per-knob env reads — direct unit-test/bench callers only; traced
+        production code must thread the session snapshot (trace-safety).
 
     Returns:
       (G, H): f32 [num_nodes, d, num_bins] for psum / no axis;
       f32 [num_nodes, padded_d/axis_size, num_bins] for reduce_scatter.
     """
-    impl = _impl()
+    impl = knobs.impl if knobs is not None else _impl()
     if impl == "per_feature":
         G, H = _hist_per_feature(bins, grad, hess, node_local, num_nodes, num_bins)
     elif impl == "matmul":
-        G, H = _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins)
+        G, H = _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins,
+                            knobs=knobs)
     elif impl == "pallas":
-        G, H = _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins)
+        G, H = _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins,
+                            knobs=knobs)
     elif impl == "flat":
         G, H = _hist_flat(bins, grad, hess, node_local, num_nodes, num_bins)
     else:
@@ -251,7 +333,7 @@ def level_histogram(
     return G, H
 
 
-def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
+def node_totals(grad, hess, node_local, num_nodes, axis_name=None, knobs=None):
     """Per-node (sum g, sum h) without the full histogram.
 
     The last tree level only needs leaf weights -> node totals; skipping the
@@ -266,15 +348,16 @@ def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
     like ``_impl``: scatter lowerings are the measured pathology on TPU
     (flat-vs-pallas histograms: 12x), so TPU defaults to ``onehot`` and
     everything else to ``segment`` — the env var overrides either way and
-    the bench probe battery A/Bs all three.
+    the bench probe battery A/Bs all three. ``knobs``: the session's
+    :class:`HistKnobs` (env fallback for direct callers).
     """
-    impl = os.environ.get("GRAFT_TOTALS_IMPL")
-    if not impl:
-        impl = "onehot" if jax.default_backend() == "tpu" else "segment"
+    impl = knobs.totals_impl if knobs is not None else _totals_impl()
     if impl == "onehot":
-        g_tot, h_tot = _totals_onehot(grad, hess, node_local, num_nodes)
+        g_tot, h_tot = _totals_onehot(grad, hess, node_local, num_nodes,
+                                      knobs=knobs)
     elif impl == "pallas":
-        g_tot, h_tot = _totals_pallas(grad, hess, node_local, num_nodes)
+        g_tot, h_tot = _totals_pallas(grad, hess, node_local, num_nodes,
+                                      knobs=knobs)
     elif impl != "segment":
         raise ValueError(
             "Unknown GRAFT_TOTALS_IMPL=%r; expected segment|onehot|pallas" % impl
@@ -294,7 +377,7 @@ def node_totals(grad, hess, node_local, num_nodes, axis_name=None):
     return g_tot, h_tot
 
 
-def _totals_onehot(grad, hess, node_local, num_nodes):
+def _totals_onehot(grad, hess, node_local, num_nodes, knobs=None):
     """[2, c] @ node-one-hot[c, W] per row chunk, f32 accumulated — no sort,
     no scatter; the one-hot never leaves registers/VMEM after fusion."""
     n = grad.shape[0]
@@ -307,7 +390,9 @@ def _totals_onehot(grad, hess, node_local, num_nodes):
     h = jnp.where(active, hess, 0.0)
     node = jnp.where(active, node_local, W)  # dead slot -> one-hot 0
 
-    chunk, steps = _balanced_chunks(n)
+    chunk, steps = _balanced_chunks(
+        n, knobs.matmul_chunk if knobs is not None else None
+    )
     n_pad = steps * chunk
     if n_pad != n:
         pad = [(0, n_pad - n)]
@@ -382,13 +467,13 @@ def _totals_pallas_fn(n, W, block, interpret):
     )
 
 
-def _totals_pallas(grad, hess, node_local, num_nodes):
+def _totals_pallas(grad, hess, node_local, num_nodes, knobs=None):
     n = grad.shape[0]
     W = num_nodes
     if n == 0:
         z = jnp.zeros(W, jnp.float32)
         return z, z
-    block = _pallas_block()
+    block = knobs.pallas_block if knobs is not None else _pallas_block()
     interpret = jax.default_backend() != "tpu"
     active = node_local >= 0
     g = jnp.where(active, grad, 0.0)
@@ -461,13 +546,19 @@ def _split_bf16(x):
     return hi, lo
 
 
-def _mxu_split_missing(B):
+def _mxu_split_missing(B, knobs=None):
     """When B = k*128 + 1 (the usual max_bin=256 -> 257 with the missing bin
     last), the one-hot dot's N dimension pads to the next lane multiple
     (257 -> 384 on the MXU, +50% wasted FLOPs). Splitting the missing column
     out — one [2W, d] dot over the (bins == B-1) mask — keeps the per-feature
     dots at an exact lane multiple. GRAFT_HIST_ALIGN=0 disables."""
-    if os.environ.get("GRAFT_HIST_ALIGN", "1") != "1":
+    if knobs is not None:
+        align = knobs.align
+    else:
+        # graftlint: disable=trace-env-read — direct-caller fallback only;
+        # sessions snapshot this via resolve_hist_knobs() at build time
+        align = os.environ.get("GRAFT_HIST_ALIGN", "1") == "1"
+    if not align:
         return False
     return B > 128 and (B - 1) % 128 == 0
 
@@ -495,7 +586,7 @@ def _dot_prec(A, Ob32, prec):
     )
 
 
-def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
+def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins, knobs=None):
     """One-hot matmul histogram, scanned over row chunks.
 
     Per chunk: A[c, 2W] = node-one-hot * (grad | hess); per feature,
@@ -507,7 +598,7 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
     n, d = bins.shape
     W = num_nodes
     B = num_bins
-    prec = _matmul_precision()
+    prec = knobs.precision if knobs is not None else _matmul_precision()
     if n == 0:
         z = jnp.zeros((W, d, B), jnp.float32)
         return z, z
@@ -515,7 +606,7 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
     # chunk rows needn't divide v here (sub-group = row index mod v), so
     # pass a block any power-of-two v divides — NOT 1, which would force
     # the divisibility loop to grind v down to 1 and disable the packing
-    v = _vnode_factor(W, 128, d, B)
+    v = _vnode_factor(W, 128, d, B, knobs=knobs)
     Wv = W * v
     active = node_local >= 0
     g = jnp.where(active, grad, 0.0)
@@ -525,7 +616,9 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         s = (jnp.arange(n, dtype=jnp.int32) % v) * W
         node = jnp.where(node >= Wv, Wv, node + s)
 
-    chunk, steps = _balanced_chunks(n)
+    chunk, steps = _balanced_chunks(
+        n, knobs.matmul_chunk if knobs is not None else None
+    )
     n_pad = steps * chunk
     if n_pad != n:
         pad = [(0, n_pad - n)]
@@ -534,7 +627,7 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
         node = jnp.pad(node, pad, constant_values=Wv)
         bins = jnp.pad(bins, pad + [(0, 0)])
 
-    split_missing = _mxu_split_missing(B)
+    split_missing = _mxu_split_missing(B, knobs=knobs)
     Bm = B - 1 if split_missing else B
     iota_w = jnp.arange(Wv, dtype=jnp.int32)
     iota_b = jnp.arange(Bm, dtype=jnp.int32)
@@ -577,7 +670,7 @@ def _hist_matmul(bins, grad, hess, node_local, num_nodes, num_bins):
 # ------------------------------------------------------------------- pallas
 
 
-def _vnode_factor(W, block, d, B):
+def _vnode_factor(W, block, d, B, knobs=None):
     """Virtual-node packing factor: the MXU processes M in 128-row tiles, so
     a [blk, 2W] @ [blk, B] dot with 2W < 128 pads M and wastes (128/2W)x the
     FLOPs — the histogram cost of a SHALLOW level would match the deepest
@@ -590,9 +683,17 @@ def _vnode_factor(W, block, d, B):
     GRAFT_VNODE_VMEM (default 4MB) — shallow levels of WIDE matrices must
     not allocate more VMEM than the deepest level the kernel already
     handles."""
-    if os.environ.get("GRAFT_HIST_VNODES", "1") != "1":
-        return 1
-    budget = int(os.environ.get("GRAFT_VNODE_VMEM", 4 * 1024 * 1024))
+    if knobs is not None:
+        if not knobs.vnodes:
+            return 1
+        budget = knobs.vnode_vmem
+    else:
+        # graftlint: disable=trace-env-read — direct-caller fallback only;
+        # sessions snapshot these via resolve_hist_knobs() at build time
+        if os.environ.get("GRAFT_HIST_VNODES", "1") != "1":
+            return 1
+        # graftlint: disable=trace-env-read — direct-caller fallback only
+        budget = env_int("GRAFT_VNODE_VMEM", 4 * 1024 * 1024, minimum=0)
     v = max(1, 128 // (2 * W))
     v = min(v, max(1, budget // (2 * W * d * B * 4)))
     while block % v or v & (v - 1):  # equal sub-groups; power of two
@@ -697,7 +798,7 @@ def _pallas_hist_fn(n, d, W, B, block, prec, interpret, split_missing, v):
     )
 
 
-def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
+def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins, knobs=None):
     n, d = bins.shape
     W = num_nodes
     B = num_bins
@@ -706,8 +807,8 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
         # kernel would return an uninitialized buffer
         zeros = jnp.zeros((W, d, B), jnp.float32)
         return zeros, zeros
-    block = _pallas_block()
-    prec = _matmul_precision()
+    block = knobs.pallas_block if knobs is not None else _pallas_block()
+    prec = knobs.precision if knobs is not None else _matmul_precision()
     interpret = jax.default_backend() != "tpu"
 
     active = node_local >= 0
@@ -724,9 +825,9 @@ def _hist_pallas(bins, grad, hess, node_local, num_nodes, num_bins):
         bins = jnp.pad(bins, pad + [(0, 0)])
 
     gh = jnp.stack([g, h], axis=1)                     # [n, 2]
-    v = _vnode_factor(W, block, d, B)
+    v = _vnode_factor(W, block, d, B, knobs=knobs)
     fn = _pallas_hist_fn(
-        n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B), v
+        n_pad, d, W, B, block, prec, interpret, _mxu_split_missing(B, knobs=knobs), v
     )
     GH = fn(bins, gh, node[:, None].astype(jnp.int32))
     if v > 1:

@@ -33,7 +33,7 @@ from .split import (
 MIN_SPLIT_LOSS = 1e-6
 
 
-def _subtraction_enabled(max_leaves, d_hist, num_bins):
+def _subtraction_enabled(max_leaves, d_hist, num_bins, knobs=None):
     """Sibling subtraction for leaf-wise growth: every split step histograms
     only the LEFT fresh child (W=1 scan over rows) and derives the right one
     from the parent's cached histogram — halving per-step histogram work.
@@ -42,7 +42,9 @@ def _subtraction_enabled(max_leaves, d_hist, num_bins):
     GRAFT_HIST_COMM lowering (same-decision-both-lowerings bit-identity
     contract — see ops.tree_build._subtraction_enabled); under
     reduce_scatter the resident cache is only the d/axis_size slice."""
-    return subtraction_enabled(2 * (2 * max_leaves - 1) * d_hist * num_bins * 4)
+    return subtraction_enabled(
+        2 * (2 * max_leaves - 1) * d_hist * num_bins * 4, knobs=knobs
+    )
 
 
 def build_tree_lossguide(
@@ -71,6 +73,7 @@ def build_tree_lossguide(
     d_global=None,
     hist_comm="psum",
     n_data_shards=1,
+    knobs=None,
 ):
     """Grow one leaf-wise tree. Returns (tree arrays dict, row_out [n]).
 
@@ -78,7 +81,9 @@ def build_tree_lossguide(
     unbounded depth (bounded by max_leaves - 1). ``hist_comm`` selects the
     data-axis collective (see ops.tree_build.build_tree): reduce_scatter
     scans only this shard's feature slice per step and merges winners into
-    the candidate store with bit-identical tie-breaking.
+    the candidate store with bit-identical tie-breaking. ``knobs``: the
+    session's ``ops.histogram.HistKnobs`` snapshot (trace-safety; None
+    falls back to env reads for direct unit-test/bench callers).
     """
     n, d = bins.shape
     max_nodes = 2 * max_leaves - 1
@@ -210,6 +215,7 @@ def build_tree_lossguide(
             G, H = level_histogram(
                 bins, grad, hess, parent_rows_mask_nodes, 2, num_bins,
                 axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
+                knobs=knobs,
             )
         splits = find_best_splits(
             G,
@@ -232,7 +238,7 @@ def build_tree_lossguide(
         return splits, gains
 
     # full-width gate under both lowerings (bit-identity: same build path)
-    subtract = _subtraction_enabled(max_leaves, d, num_bins)
+    subtract = _subtraction_enabled(max_leaves, d, num_bins, knobs=knobs)
     if subtract:
         # per-node histogram cache (filled as leaves are created); stores
         # only this shard's feature slice under reduce_scatter
@@ -244,6 +250,7 @@ def build_tree_lossguide(
     G, H = level_histogram(
         bins, grad, hess, root_local, 1, num_bins,
         axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
+        knobs=knobs,
     )
     if subtract:
         hist_G = hist_G.at[0].set(G[0])
@@ -364,6 +371,7 @@ def build_tree_lossguide(
             Ga, Ha = level_histogram(
                 bins, grad, hess, left_local, 1, num_bins,
                 axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
+                knobs=knobs,
             )
             Gb = jnp.where(can, hist_G[l] - Ga[0], 0.0)
             Hb = jnp.where(can, hist_H[l] - Ha[0], 0.0)

@@ -36,7 +36,7 @@ from .split import (
 MIN_SPLIT_LOSS = 1e-6  # xgboost kRtEps
 
 
-def row_bin_lookup(bins, feat_idx):
+def row_bin_lookup(bins, feat_idx, impl=None):
     """Per-row bin of a per-row feature: ``bins[i, feat_idx[i]]``.
 
     Two lowerings, A/B-able on hardware via ``GRAFT_ROUTE_IMPL``:
@@ -46,9 +46,15 @@ def row_bin_lookup(bins, feat_idx):
     * ``onehot``: masked sum over the feature axis — n*d VPU multiply-adds,
       no gather; can win on TPU where cross-lane gathers serialize.
 
-    Both used by level routing here and binned eval prediction.
+    Both used by level routing here and binned eval prediction. ``impl``:
+    the session's ``HistKnobs.route_impl`` (env fallback for direct
+    callers).
     """
-    if os.environ.get("GRAFT_ROUTE_IMPL", "gather") == "onehot":
+    if impl is None:
+        # graftlint: disable=trace-env-read — direct-caller fallback only;
+        # sessions snapshot this via resolve_hist_knobs() at build time
+        impl = os.environ.get("GRAFT_ROUTE_IMPL", "gather")
+    if impl == "onehot":
         d = bins.shape[1]
         oh = feat_idx[:, None] == jnp.arange(d, dtype=jnp.int32)[None, :]
         return jnp.sum(jnp.where(oh, bins, 0).astype(jnp.int32), axis=1)
@@ -59,7 +65,7 @@ def max_nodes_for_depth(max_depth):
     return 2 ** (max_depth + 1) - 1
 
 
-def _subtraction_enabled(max_depth, d_hist, num_bins):
+def _subtraction_enabled(max_depth, d_hist, num_bins, knobs=None):
     """Histogram subtraction: build only left children, derive right ones as
     parent - left (libxgboost's standard sibling trick) — halves histogram
     work per level. Needs the previous level's histograms cached
@@ -71,7 +77,9 @@ def _subtraction_enabled(max_depth, d_hist, num_bins):
     slice (1/axis_size of this estimate)."""
     if max_depth < 2:
         return False
-    return subtraction_enabled(2 * (2 ** (max_depth - 1)) * d_hist * num_bins * 4)
+    return subtraction_enabled(
+        2 * (2 ** (max_depth - 1)) * d_hist * num_bins * 4, knobs=knobs
+    )
 
 
 def build_tree(
@@ -99,6 +107,7 @@ def build_tree(
     d_global=None,
     hist_comm="psum",
     n_data_shards=1,
+    knobs=None,
 ):
     """Grow one tree. Returns (tree arrays dict, row_out f32 [n]).
 
@@ -122,6 +131,10 @@ def build_tree(
     axis IS a feature axis for the duration of the split scan). Tie-breaking
     (max gain, lowest global feature id) and node totals are bit-identical
     to the psum lowering, so committed trees match bitwise.
+
+    knobs: the session's ``ops.histogram.HistKnobs`` snapshot (trace-safety:
+    the traced build must not read env; None falls back to per-knob env
+    reads for direct unit-test/bench callers).
     """
     n, d = bins.shape
     reduce_scatter = hist_comm == "reduce_scatter" and axis_name is not None
@@ -174,7 +187,7 @@ def build_tree(
     # commit bitwise-divergent trees in the (cap/p, cap] window, breaking
     # the bit-identity contract. The resident cache under reduce_scatter is
     # still only the [W/2, d_scan, B] slice (1/p of the gate's estimate).
-    subtract = _subtraction_enabled(max_depth, d, num_bins)
+    subtract = _subtraction_enabled(max_depth, d, num_bins, knobs=knobs)
     G_cache = H_cache = None      # previous level's [W/2, d_scan, B] histograms
     parent_leaf = None            # previous level's becomes_leaf [W/2]
 
@@ -188,7 +201,7 @@ def build_tree(
             # weights only need per-node g/h totals — skip the full (widest,
             # most expensive) [W, d, B] histogram of the tree entirely.
             g_tot, h_tot = node_totals(
-                grad, hess, node_local, width, axis_name=axis_name
+                grad, hess, node_local, width, axis_name=axis_name, knobs=knobs
             )
             weight = leaf_weight(
                 g_tot, h_tot,
@@ -214,6 +227,7 @@ def build_tree(
             Gl, Hl = level_histogram(
                 bins, grad, hess, left_local, width // 2, num_bins,
                 axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
+                knobs=knobs,
             )
             keep = ~parent_leaf
             Gp = jnp.where(keep[:, None, None], G_cache, 0.0)
@@ -226,6 +240,7 @@ def build_tree(
             G, H = level_histogram(
                 bins, grad, hess, node_local, width, num_bins,
                 axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
+                knobs=knobs,
             )
         if subtract:
             G_cache, H_cache = G, H
@@ -337,7 +352,9 @@ def build_tree(
         split_feat = splits["feature"][local_safe]
         split_bin = splits["bin"][local_safe]
         if feature_axis_name is None:
-            row_bin = row_bin_lookup(bins, split_feat)
+            row_bin = row_bin_lookup(
+                bins, split_feat, impl=knobs.route_impl if knobs else None
+            )
             is_missing = row_bin == (num_bins - 1)
             go_right = jnp.where(
                 is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
@@ -347,7 +364,9 @@ def build_tree(
             # rows; decisions psum-broadcast along the feature axis
             owner = (split_feat // d) == feat_shard
             local_idx = jnp.clip(split_feat - feat_shard * d, 0, d - 1)
-            row_bin = row_bin_lookup(bins, local_idx)
+            row_bin = row_bin_lookup(
+                bins, local_idx, impl=knobs.route_impl if knobs else None
+            )
             is_missing = row_bin == (num_bins - 1)
             decision = jnp.where(
                 is_missing, ~splits["default_left"][local_safe], row_bin > split_bin
@@ -428,7 +447,7 @@ def unpack_tree(packed):
     return out
 
 
-def predict_binned(tree, bins, max_depth, num_bins):
+def predict_binned(tree, bins, max_depth, num_bins, route_impl=None):
     """Apply one trained tree to binned rows -> margins.
 
     Traverses explicit child indices (leaves self-loop) under a
@@ -437,7 +456,10 @@ def predict_binned(tree, bins, max_depth, num_bins):
     depthwise trees, max_leaves-1 for lossguide), so a 256-leaf lossguide
     tree of actual depth ~8 costs ~8 gather rounds, not 255. Used for
     validation-set evaluation during training (validation is binned with the
-    training cuts, so bin comparison == float comparison).
+    training cuts, so bin comparison == float comparison). ``route_impl``:
+    the session's ``HistKnobs.route_impl`` — traced callers must thread it
+    (trace-safety; None falls back to an env read for direct unit-test
+    callers only).
     """
     n = bins.shape[0]
 
@@ -449,7 +471,7 @@ def predict_binned(tree, bins, max_depth, num_bins):
         i, node = state
         feat = tree["feature"][node]
         split_bin = tree["bin"][node]
-        row_bin = row_bin_lookup(bins, feat)
+        row_bin = row_bin_lookup(bins, feat, impl=route_impl)
         is_missing = row_bin == (num_bins - 1)
         go_right = jnp.where(is_missing, ~tree["default_left"][node], row_bin > split_bin)
         child = jnp.where(go_right, tree["right"][node], tree["left"][node])

@@ -72,16 +72,6 @@ def wait_hostname_resolution(sm_hosts, max_wait_seconds=900):
                 delay *= 2
 
 
-def recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
-
-
 def frame_message(obj):
     """Length-prefixed JSON framing: ``<u32 little-endian length><payload>``.
 
@@ -98,18 +88,29 @@ def send_message(sock, obj):
     sock.sendall(frame_message(obj))
 
 
-def recv_message(sock):
-    (length,) = struct.unpack("<I", recv_exact(sock, 4))
-    return json.loads(recv_exact(sock, length).decode())
+def recv_message(sock, timeout=None):
+    """One framed message under a TOTAL deadline.
+
+    Historically this was the *unbounded* reader (a ``recv`` loop whose
+    per-chunk timeout reset forever — the exact trickle-wedge class
+    ``recv_message_bounded`` was built to kill, and the graftlint
+    ``socket-unbounded`` rule now rejects). It survives as a convenience
+    wrapper over the bounded reader with the rendezvous default deadline
+    (``SM_SYNC_RECV_TIMEOUT_S``); pass ``timeout`` to override.
+    """
+    return recv_message_bounded(
+        sock, sync_recv_timeout() if timeout is None else timeout
+    )
 
 
 def recv_message_bounded(sock, timeout, max_bytes=MAX_CONTROL_FRAME_BYTES):
     """Read one framed message under a TOTAL deadline.
 
-    ``recv_message``'s per-recv timeout resets on every chunk, so a peer
-    trickling one byte per timeout window can hold the reader indefinitely
-    — exactly the wedge this variant exists to bound. Also sanity-caps the
-    length prefix so a stray client can't make us block on (or allocate) a
+    A per-recv timeout that resets on every chunk lets a peer trickling
+    one byte per timeout window hold the reader indefinitely — exactly
+    the wedge this reader exists to bound (and ``recv_message`` now
+    delegates here rather than risk it). Also sanity-caps the length
+    prefix so a stray client can't make us block on (or allocate) a
     garbage frame. Shared by the rendezvous collect loop, the heartbeat
     aggregator, and the abort listener.
     """
@@ -135,7 +136,6 @@ def recv_message_bounded(sock, timeout, max_bytes=MAX_CONTROL_FRAME_BYTES):
 
 
 # historical private names, kept for in-repo callers
-_recv_exact = recv_exact
 _send_msg = send_message
 _recv_msg = recv_message
 

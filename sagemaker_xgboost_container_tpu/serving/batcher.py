@@ -233,6 +233,8 @@ class PredictBatcher:
             if nxt.features.shape[1] != first.features.shape[1]:
                 # different width (e.g. mid-flight model swap): defer to its
                 # own batch (re-putting could block on a bounded queue)
+                # graftlint: disable=shared-state-unlocked — the only caller
+                # (_worker) holds _exec_lock around every _drain_batch call
                 self._carry = nxt
                 break
             batch.append(nxt)
@@ -242,9 +244,16 @@ class PredictBatcher:
     def _worker(self):
         loaded = False  # previous batch coalesced -> linger for stragglers
         while True:
-            if self._carry is not None:
+            # swap the carry out UNDER the exec lock so the inline fast
+            # path's `self._carry is None` check (made while holding it)
+            # always observes a consistent value (graftlint
+            # shared-state-unlocked). The lock is dropped before the drain
+            # below, so an inline run may still execute between this swap
+            # and the carried request's dispatch — that ordering was always
+            # permitted; the lock only makes the state transition atomic.
+            with self._exec_lock:
                 first, self._carry = self._carry, None
-            else:
+            if first is None:
                 first = self._queue.get()
             # drain INSIDE the exec lock: while an inline run holds it, the
             # worker must not vacuum the queue into a private batch — queued

@@ -1,0 +1,400 @@
+"""graftlint core: file model, suppressions, baseline, pass runner.
+
+The analysis unit is a :class:`Project` — a repo root, the package's
+``*.py`` files parsed once into ASTs, and the contract docs. Passes are
+stateless objects with a ``rules`` dict (rule id -> one-line description)
+and ``run(project) -> [Finding]``; everything cross-file (call graphs, the
+contract inventories) is built per pass from ``project.files``.
+
+Suppressions are per-line: ``# graftlint: disable=<rule>[,<rule>] <reason>``
+on the offending line, or on a comment-only line directly above it. The
+reason string is mandatory policy — a reason-less suppression still
+suppresses (so CI doesn't double-fail a line someone is mid-annotating) but
+is itself reported as ``suppression-missing-reason``.
+
+The baseline file grandfathers findings by (rule, path, stripped source
+line) — line *content*, not line number, so unrelated edits above a
+baselined finding don't resurrect it. Etiquette: the baseline exists for
+landing the analyzer across an imperfect tree, not for parking new debt;
+see docs/static-analysis.md.
+"""
+
+import ast
+import json
+import os
+import re
+
+PACKAGE = "sagemaker_xgboost_container_tpu"
+
+# relative to the repo root
+DEFAULT_BASELINE = "scripts/graftlint_baseline.json"
+
+#: docs whose *tables* are authoritative for the contract pass (both
+#: directions: code names must appear in these files or their satellites,
+#: and table rows here must name things that still exist in code)
+CONTRACT_TABLE_DOCS = ("docs/observability.md", "docs/robustness.md")
+
+#: the wider "documented somewhere curated" set — enough to satisfy the
+#: undocumented-name direction (DESIGN.md owns the perf-knob deep dives)
+DOCUMENTED_SOURCE_DOCS = CONTRACT_TABLE_DOCS + (
+    "docs/DESIGN.md",
+    "docs/MIGRATION.md",
+    "docs/static-analysis.md",
+)
+
+#: generated code is not subject to policy
+SKIP_FILES = {"data/record_pb2.py"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([a-z0-9*\-]+(?:\s*,\s*[a-z0-9*\-]+)*)\s*(.*)$"
+)
+
+
+class Finding(object):
+    """One rule violation at a file:line."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule, path, line, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def as_dict(self):
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def __repr__(self):
+        return "Finding({}:{} {})".format(self.path, self.line, self.rule)
+
+
+class Suppression(object):
+    __slots__ = ("rules", "reason", "line", "used")
+
+    def __init__(self, rules, reason, line):
+        self.rules = rules
+        self.reason = reason
+        self.line = line
+        self.used = False
+
+    def covers(self, rule):
+        return "*" in self.rules or rule in self.rules
+
+
+class SourceFile(object):
+    """One parsed python file: AST + per-line suppressions."""
+
+    def __init__(self, abspath, relpath, text):
+        self.abspath = abspath
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.error = None
+        try:
+            self.tree = ast.parse(text, filename=relpath)
+        except SyntaxError as e:
+            self.tree = None
+            self.error = "cannot parse {}: {}".format(relpath, e)
+        # module dotted path (for import resolution), when under the package
+        parts = relpath[:-3].replace(os.sep, "/").split("/")
+        self.module = ".".join(parts)
+        self._suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self):
+        by_line = {}
+        pending = None  # suppression from a comment-only line -> next line
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            stripped = line.strip()
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                sup = Suppression(rules, m.group(2).strip(), lineno)
+                by_line.setdefault(lineno, []).append(sup)
+                if stripped.startswith("#"):
+                    pending = sup  # applies to the next code line too
+                continue
+            if pending is not None and stripped and not stripped.startswith("#"):
+                by_line.setdefault(lineno, []).append(pending)
+                pending = None
+        return by_line
+
+    def suppression_for(self, line, rule):
+        for sup in self._suppressions.get(line, ()):
+            if sup.covers(rule):
+                return sup
+        return None
+
+    def all_suppressions(self):
+        seen = set()
+        for sups in self._suppressions.values():
+            for sup in sups:
+                if id(sup) not in seen:
+                    seen.add(id(sup))
+                    yield sup
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class DocFile(object):
+    __slots__ = ("abspath", "relpath", "text", "lines")
+
+    def __init__(self, abspath, relpath, text):
+        self.abspath = abspath
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+
+
+class Project(object):
+    """Repo root + parsed package sources + contract docs."""
+
+    def __init__(self, root, paths=None):
+        self.root = os.path.abspath(root)
+        self.files = []
+        self.errors = []
+        for path in self._py_paths(paths):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            pkg_rel = self._package_rel(rel)
+            if pkg_rel in SKIP_FILES:
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    text = f.read()
+            except OSError as e:
+                self.errors.append("cannot read {}: {}".format(rel, e))
+                continue
+            sf = SourceFile(path, rel, text)
+            if sf.error:
+                self.errors.append(sf.error)
+            self.files.append(sf)
+        self.docs = []
+        for rel in DOCUMENTED_SOURCE_DOCS:
+            abspath = os.path.join(self.root, rel)
+            if not os.path.isfile(abspath):
+                continue
+            with open(abspath, "r", encoding="utf-8") as f:
+                self.docs.append(DocFile(abspath, rel, f.read()))
+
+    def _py_paths(self, paths):
+        if not paths:
+            pkg = os.path.join(self.root, PACKAGE)
+            paths = [pkg if os.path.isdir(pkg) else self.root]
+        out = []
+        for p in paths:
+            p = os.path.join(self.root, p) if not os.path.isabs(p) else p
+            if os.path.isfile(p):
+                out.append(p)
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        return out
+
+    def _package_rel(self, rel):
+        prefix = PACKAGE + "/"
+        if rel.startswith(prefix):
+            return rel[len(prefix):]
+        # fixture trees keep the package-dir convention of the old gates
+        idx = rel.find("/" + prefix)
+        if idx >= 0:
+            return rel[idx + 1 + len(prefix):]
+        return rel
+
+    def file_by_rel(self, relpath):
+        for sf in self.files:
+            if sf.relpath == relpath:
+                return sf
+        return None
+
+    def doc_table_files(self):
+        return [d for d in self.docs if d.relpath in CONTRACT_TABLE_DOCS]
+
+
+class Report(object):
+    def __init__(self):
+        self.findings = []      # live findings (post suppression + baseline)
+        self.baselined = []     # matched against the baseline file
+        self.suppressed = []    # (finding, suppression) pairs
+        self.errors = []
+
+    def stats(self):
+        counts = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return counts
+
+    def all_stats(self):
+        """Rule hit counts including suppressed + baselined findings — the
+        --stats view of which guardrails are load-bearing."""
+        counts = {}
+        for f in self.findings:
+            counts.setdefault(f.rule, [0, 0, 0])[0] += 1
+        for f, _ in self.suppressed:
+            counts.setdefault(f.rule, [0, 0, 0])[1] += 1
+        for f in self.baselined:
+            counts.setdefault(f.rule, [0, 0, 0])[2] += 1
+        return counts
+
+
+def _baseline_key(project, finding):
+    sf = project.file_by_rel(finding.path)
+    context = sf.line_text(finding.line) if sf is not None else ""
+    return "{}|{}|{}".format(finding.rule, finding.path, context)
+
+
+def load_baseline_entries(path):
+    """The raw entry dicts (rule/path/context) of a baseline file."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def load_baseline(path):
+    entries = {}
+    for entry in load_baseline_entries(path):
+        key = "{}|{}|{}".format(
+            entry.get("rule", ""), entry.get("path", ""), entry.get("context", "")
+        )
+        entries[key] = entries.get(key, 0) + 1
+    return entries
+
+
+def write_baseline(path, project, findings, comment=None, extra_entries=None):
+    """Write ``findings`` (plus pre-built ``extra_entries`` dicts — the
+    CLI's carry-over of entries a narrowed run had no chance to re-match)
+    as the baseline at ``path``."""
+    entries = []
+    for f in findings:
+        sf = project.file_by_rel(f.path)
+        entries.append(
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "context": sf.line_text(f.line) if sf is not None else "",
+            }
+        )
+    entries.extend(extra_entries or ())
+    entries.sort(
+        key=lambda e: (e.get("path", ""), e.get("rule", ""), e.get("context", ""))
+    )
+    data = {
+        "comment": comment
+        or "graftlint grandfathered findings. Keep EMPTY: fix or inline-"
+        "suppress (with a reason) instead of parking debt here — see "
+        "docs/static-analysis.md.",
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def all_passes():
+    from .passes import ALL_PASSES
+
+    return [cls() for cls in ALL_PASSES]
+
+
+def known_rules():
+    rules = {"suppression-missing-reason": "a suppression comment lacks a reason string"}
+    for p in all_passes():
+        rules.update(p.rules)
+    return rules
+
+
+def run(
+    root,
+    paths=None,
+    select=None,
+    disable=None,
+    baseline_path=None,
+    use_baseline=True,
+):
+    """Run every (selected) pass over ``root`` -> :class:`Report`.
+
+    ``select``/``disable`` are rule-id collections. ``baseline_path`` None
+    means the checked-in default (when present).
+    """
+    project = Project(root, paths=paths)
+    report = Report()
+    report.errors.extend(project.errors)
+
+    selected = set(select) if select else None
+    disabled = set(disable) if disable else set()
+
+    raw = []
+    for p in all_passes():
+        pass_rules = {
+            r for r in p.rules
+            if (selected is None or r in selected) and r not in disabled
+        }
+        if not pass_rules:
+            continue
+        try:
+            for finding in p.run(project):
+                if finding.rule in pass_rules:
+                    raw.append(finding)
+        except Exception as e:  # a broken pass must fail loudly, not pass CI
+            report.errors.append("pass {} crashed: {!r}".format(type(p).__name__, e))
+
+    # 1. suppressions
+    unsuppressed = []
+    for f in raw:
+        sf = project.file_by_rel(f.path)
+        sup = sf.suppression_for(f.line, f.rule) if sf is not None else None
+        if sup is not None:
+            sup.used = True
+            report.suppressed.append((f, sup))
+        else:
+            unsuppressed.append(f)
+
+    # a suppression that fired without a reason is itself a finding
+    meta_rule = "suppression-missing-reason"
+    if (selected is None or meta_rule in selected) and meta_rule not in disabled:
+        for sf in project.files:
+            for sup in sf.all_suppressions():
+                if sup.used and not sup.reason:
+                    unsuppressed.append(
+                        Finding(
+                            meta_rule,
+                            sf.relpath,
+                            sup.line,
+                            "suppression without a reason string — say why "
+                            "this finding is intentionally kept",
+                        )
+                    )
+
+    # 2. baseline
+    baseline = {}
+    if use_baseline:
+        candidate = baseline_path or os.path.join(project.root, DEFAULT_BASELINE)
+        if os.path.isfile(candidate):
+            try:
+                baseline = load_baseline(candidate)
+            except (OSError, ValueError) as e:
+                report.errors.append("cannot load baseline {}: {}".format(candidate, e))
+    remaining = dict(baseline)
+    for f in unsuppressed:
+        key = _baseline_key(project, f)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.project = project
+    return report

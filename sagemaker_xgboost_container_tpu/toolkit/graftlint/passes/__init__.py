@@ -1,0 +1,14 @@
+"""graftlint pass registry. Order is presentation-only; every selected pass
+always runs."""
+
+from .legacy import LegacyGatesPass
+from .trace import TraceSafetyPass
+from .concurrency import ConcurrencyPass
+from .contract import ContractDriftPass
+
+ALL_PASSES = [
+    TraceSafetyPass,
+    ConcurrencyPass,
+    ContractDriftPass,
+    LegacyGatesPass,
+]

@@ -1,0 +1,240 @@
+"""Contract-drift pass: code vs the documented operational surface.
+
+CHANGES.md shows five PRs each adding env knobs, metrics, fault points and
+exit codes — and the docs drifting a little further behind every time.
+This pass extracts the *actual* surface from the AST and cross-checks it
+against the curated tables in ``docs/observability.md`` and
+``docs/robustness.md``, in both directions:
+
+* ``contract-*-undocumented`` — a name the code exposes but no curated doc
+  mentions. Operators discover knobs from the tables, not the source.
+* ``contract-*-orphaned`` — a curated table row naming something no longer
+  in the code. A runbook step that greps for a metric that stopped
+  existing is worse than no runbook.
+
+Inventories:
+
+* **env knobs** — ``SM_*``/``GRAFT_*`` names read via ``os.environ``/
+  ``os.getenv``/the ``envconfig`` helpers (literal or module-level
+  ``*_ENV`` constant). Platform-contract names (values of constants in
+  ``constants.py``, e.g. ``SM_HOSTS``) are the SageMaker API, documented
+  upstream, and exempt. ``SAGEMAKER_*`` serving platform vars are likewise
+  out of scope here.
+* **metrics** — literal names passed to the registry's
+  ``counter``/``gauge``/``histogram``. (Orphan direction matches any
+  string literal in the package, so table-driven loops — the cluster fold
+  loop — don't false-positive.)
+* **fault points** — literal first args of ``fault_point(...)``.
+* **exit codes** — ``EXIT_*`` int constants in ``constants.py`` vs the
+  robustness exit-code table (supervision range 79–99 both ways).
+
+Fixture trees without the docs skip this pass (nothing to check against).
+"""
+
+import ast
+import re
+
+from ..core import Finding
+from ..astutil import (
+    dotted_name,
+    module_int_constants,
+    module_str_constants,
+    str_const,
+)
+
+_ENV_PATTERN = re.compile(r"^(SM|GRAFT)_[A-Z0-9_]+$")
+_METRIC_PATTERN = re.compile(r"^[a-z][a-z0-9_]*_[a-z0-9_]+$")
+_FAULT_PATTERN = re.compile(r"^[a-z_]+\.[a-z_]+$")
+_BACKTICK = re.compile(r"`([^`\s][^`]*)`")
+_TABLE_CELL = re.compile(r"^\|\s*`([^`]+)`")
+_ENV_READERS = {"os.getenv", "os.environ.get", "environ.get", "getenv",
+                "os.environ.setdefault", "environ.setdefault"}
+_ENVCONFIG_HELPERS = {"env_int", "env_float", "env_bool"}
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+
+class ContractDriftPass(object):
+    rules = {
+        "contract-env-undocumented": "SM_*/GRAFT_* knob read in code but absent from the docs",
+        "contract-env-orphaned": "doc table documents an env knob no code reads",
+        "contract-metric-undocumented": "registry metric absent from the docs",
+        "contract-metric-orphaned": "doc table documents a metric not in code",
+        "contract-fault-undocumented": "fault point absent from docs/robustness.md",
+        "contract-fault-orphaned": "doc table documents a fault point not in code",
+        "contract-exit-undocumented": "EXIT_* code absent from the robustness exit table",
+        "contract-exit-orphaned": "doc exit-code row with no EXIT_* constant behind it",
+    }
+
+    def run(self, project):
+        table_docs = project.doc_table_files()
+        if not table_docs:
+            return
+
+        env_uses, metric_uses, fault_uses, exit_codes, platform_env, literals = \
+            self._code_inventory(project)
+        documented = self._documented_tokens(project)
+        doc_env, doc_metrics, doc_faults, doc_exits = self._doc_tables(table_docs)
+
+        # ---- code -> docs
+        for name, (path, line) in sorted(env_uses.items()):
+            if name in platform_env or not _ENV_PATTERN.match(name):
+                continue
+            if name not in documented:
+                yield Finding(
+                    "contract-env-undocumented", path, line,
+                    "env knob {} is read here but documented in none of the "
+                    "curated docs — add a row to the knob tables in "
+                    "docs/observability.md or docs/robustness.md".format(name),
+                )
+        for name, (path, line) in sorted(metric_uses.items()):
+            if name not in documented:
+                yield Finding(
+                    "contract-metric-undocumented", path, line,
+                    "metric {} is registered here but documented nowhere — "
+                    "add it to the catalogue in docs/observability.md".format(name),
+                )
+        for name, (path, line) in sorted(fault_uses.items()):
+            if name not in documented:
+                yield Finding(
+                    "contract-fault-undocumented", path, line,
+                    "fault point {} is armed here but absent from the fault-"
+                    "point catalogue in docs/robustness.md".format(name),
+                )
+        for name, (value, path, line) in sorted(exit_codes.items()):
+            if value not in doc_exits:
+                yield Finding(
+                    "contract-exit-undocumented", path, line,
+                    "exit code {} ({}) is missing from the exit-code table "
+                    "in docs/robustness.md".format(value, name),
+                )
+
+        # ---- docs -> code
+        code_exit_values = {v for v, _, _ in exit_codes.values()}
+        for name, (path, line) in sorted(doc_env.items()):
+            if name not in literals:
+                yield Finding(
+                    "contract-env-orphaned", path, line,
+                    "documented env knob {} no longer appears anywhere in "
+                    "the package — delete the row or restore the knob".format(name),
+                )
+        for name, (path, line) in sorted(doc_metrics.items()):
+            if name not in literals:
+                yield Finding(
+                    "contract-metric-orphaned", path, line,
+                    "documented metric {} no longer appears anywhere in the "
+                    "package — delete the row or restore the metric".format(name),
+                )
+        for name, (path, line) in sorted(doc_faults.items()):
+            if name not in fault_uses and name not in literals:
+                yield Finding(
+                    "contract-fault-orphaned", path, line,
+                    "documented fault point {} has no fault_point() site in "
+                    "the package".format(name),
+                )
+        for value, (path, line) in sorted(doc_exits.items()):
+            if 79 <= value <= 99 and value not in code_exit_values:
+                yield Finding(
+                    "contract-exit-orphaned", path, line,
+                    "documented exit code {} has no EXIT_* constant in "
+                    "constants.py".format(value),
+                )
+
+    # ------------------------------------------------------- code inventory
+    def _code_inventory(self, project):
+        env_uses = {}
+        metric_uses = {}
+        fault_uses = {}
+        exit_codes = {}
+        platform_env = set()
+        literals = set()
+
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            constants = module_str_constants(sf.tree)
+            pkg_rel = project._package_rel(sf.relpath)
+            if pkg_rel == "constants.py":
+                for cname, value in constants.items():
+                    if cname == value and _ENV_PATTERN.match(value):
+                        platform_env.add(value)
+                for cname, value in module_int_constants(sf.tree).items():
+                    if cname.startswith("EXIT_"):
+                        exit_codes[cname] = (value, sf.relpath, 1)
+
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    literals.add(node.value)
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func) or ""
+                leaf = callee.rsplit(".", 1)[-1]
+                first = self._first_str(node, constants)
+                if callee in _ENV_READERS or callee in _ENVCONFIG_HELPERS:
+                    if first and _ENV_PATTERN.match(first):
+                        env_uses.setdefault(first, (sf.relpath, node.lineno))
+                elif leaf in _REGISTRY_METHODS and isinstance(node.func, ast.Attribute):
+                    if first and _METRIC_PATTERN.match(first):
+                        metric_uses.setdefault(first, (sf.relpath, node.lineno))
+                elif leaf == "fault_point":
+                    if first and _FAULT_PATTERN.match(first):
+                        fault_uses.setdefault(first, (sf.relpath, node.lineno))
+            # os.environ["X"] subscripts
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Subscript):
+                    base = dotted_name(node.value) or ""
+                    if base in ("os.environ", "environ"):
+                        key = str_const(node.slice)
+                        if key is None and isinstance(node.slice, ast.Name):
+                            key = constants.get(node.slice.id)
+                        if key and _ENV_PATTERN.match(key):
+                            env_uses.setdefault(key, (sf.relpath, node.lineno))
+        return env_uses, metric_uses, fault_uses, exit_codes, platform_env, literals
+
+    def _first_str(self, call, constants):
+        if not call.args:
+            return None
+        lit = str_const(call.args[0])
+        if lit is not None:
+            return lit
+        if isinstance(call.args[0], ast.Name):
+            return constants.get(call.args[0].id)
+        return None
+
+    # -------------------------------------------------------- doc inventory
+    def _documented_tokens(self, project):
+        tokens = set()
+        for doc in project.docs:
+            for m in _BACKTICK.finditer(doc.text):
+                tokens.add(self._normalize(m.group(1)))
+            # env names also count when they appear in prose/code fences
+            for m in re.finditer(r"\b(?:SM|GRAFT)_[A-Z0-9_]+\b", doc.text):
+                tokens.add(m.group(0))
+        return tokens
+
+    def _normalize(self, token):
+        token = token.strip()
+        if "{" in token:
+            token = token.split("{", 1)[0]
+        return token.strip("`= ")
+
+    def _doc_tables(self, table_docs):
+        doc_env = {}
+        doc_metrics = {}
+        doc_faults = {}
+        doc_exits = {}
+        for doc in table_docs:
+            for lineno, line in enumerate(doc.lines, start=1):
+                m = _TABLE_CELL.match(line.strip())
+                if not m:
+                    continue
+                raw = m.group(1)
+                name = self._normalize(raw)
+                if _ENV_PATTERN.match(name):
+                    doc_env.setdefault(name, (doc.relpath, lineno))
+                elif _FAULT_PATTERN.match(name):
+                    doc_faults.setdefault(name, (doc.relpath, lineno))
+                elif name.isdigit():
+                    doc_exits.setdefault(int(name), (doc.relpath, lineno))
+                elif _METRIC_PATTERN.match(name):
+                    doc_metrics.setdefault(name, (doc.relpath, lineno))
+        return doc_env, doc_metrics, doc_faults, doc_exits

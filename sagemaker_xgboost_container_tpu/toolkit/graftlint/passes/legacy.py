@@ -1,0 +1,102 @@
+"""Legacy gates, re-homed: no-print and no-bare-except.
+
+These shipped as standalone scripts in PRs 1 and 3
+(``scripts/check_no_print.py`` / ``check_no_bare_except.py``); the scripts
+survive as thin shims over these rules so existing tox/ci.sh invocations
+and tests keep working, but the policy now lives here.
+
+* ``no-print`` — telemetry flows through the registry/logger/emit layer; a
+  stray ``print`` bypasses the CloudWatch metric-definition contract and
+  pollutes the HPO stdout scrape surface. The allowlist names the files
+  whose prints ARE a stdout contract.
+* ``no-bare-except`` — a bare ``except:`` swallows
+  KeyboardInterrupt/SystemExit, which in a container whose supervision
+  layer exits through classified ``os._exit`` codes (docs/robustness.md)
+  can eat the very control-flow exceptions the failure-domain machinery
+  depends on.
+"""
+
+import ast
+
+from ..core import Finding
+
+#: files whose print() calls are a stdout *contract* (HPO eval lines, CV
+#: metric lines, the version-contract CLI verdict, the emit sink itself) —
+#: paths relative to the package root
+PRINT_ALLOWLIST = {
+    "training/callbacks.py",
+    "training/algorithm_train.py",
+    "version_contract.py",
+    "telemetry/emit.py",
+}
+
+
+def _print_linenos(tree):
+    """The one no-print predicate — the pass and the shim API both walk
+    through here so the policy can't silently fork."""
+    return sorted(
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    )
+
+
+def _bare_except_linenos(tree):
+    """The one no-bare-except predicate (see :func:`_print_linenos`)."""
+    return sorted(
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ExceptHandler) and node.type is None
+    )
+
+
+def _parse(source, filename):
+    try:
+        return ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        raise RuntimeError("cannot parse {}: {}".format(filename, e))
+
+
+def find_print_calls(source, filename):
+    """[lineno] of calls to the ``print`` builtin (AST-based: strings and
+    comments mentioning print() don't trip it). Kept name-compatible with
+    the old ``scripts/check_no_print.py`` module API."""
+    return _print_linenos(_parse(source, filename))
+
+
+def find_bare_excepts(source, filename):
+    """[lineno] of bare ``except:`` handler clauses. Kept name-compatible
+    with the old ``scripts/check_no_bare_except.py`` module API."""
+    return _bare_except_linenos(_parse(source, filename))
+
+
+class LegacyGatesPass(object):
+    rules = {
+        "no-print": "print() outside the stdout-contract allowlist",
+        "no-bare-except": "bare except: clause (names no exception type)",
+    }
+
+    def run(self, project):
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            pkg_rel = project._package_rel(sf.relpath)
+            if pkg_rel not in PRINT_ALLOWLIST:
+                for lineno in _print_linenos(sf.tree):
+                    yield Finding(
+                        "no-print",
+                        sf.relpath,
+                        lineno,
+                        "print() outside allowlist (route output through "
+                        "telemetry.emit_metric or a logger)",
+                    )
+            for lineno in _bare_except_linenos(sf.tree):
+                yield Finding(
+                    "no-bare-except",
+                    sf.relpath,
+                    lineno,
+                    "bare except (name the exception type — "
+                    "'except Exception:' at minimum)",
+                )

@@ -1,77 +1,46 @@
 #!/usr/bin/env python
-"""Static check: no bare ``except:`` in the package.
+"""DEPRECATED shim: the no-bare-except policy now lives in graftlint.
 
-A bare except swallows KeyboardInterrupt/SystemExit and — in a container
-whose supervision layer aborts via ``os._exit`` paths and classified exit
-codes (docs/robustness.md) — can eat the very control-flow exceptions the
-failure-domain machinery depends on. Every handler must name a type
-(``except Exception:`` at minimum, which leaves BaseException control flow
-alone).
+This script shipped in PR 3 as a standalone AST gate; the policy moved to
+the ``no-bare-except`` rule of the repo's static analyzer
+(``sagemaker_xgboost_container_tpu/toolkit/graftlint``, see
+docs/static-analysis.md). The shim keeps the historical entrypoint and
+module API (``find_bare_excepts``) working for existing tox/ci.sh
+invocations and tests; new wiring should invoke the analyzer directly::
 
-AST-based like its sibling check_no_print.py: only real ``except:`` handler
-clauses trip it, not strings or comments. Exit 0 clean, 1 with findings,
-2 on unparseable files. Wired into tox (fast/full), scripts/ci.sh, and the
-chaos tier (tests/test_robustness.py).
+    python scripts/graftlint.py --select no-bare-except
+
+(graftlint is loaded through ``scripts/graftlint.py`` rather than as a
+product submodule so the gate still reports — exit 2 — on a tree whose
+package ``__init__`` chain doesn't even import.)
+
+Exit codes unchanged: 0 clean, 1 with findings, 2 on unparseable files.
 """
 
-import ast
 import os
 import sys
 
-PACKAGE = "sagemaker_xgboost_container_tpu"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
 
+from graftlint import load_submodule  # noqa: E402  (scripts/graftlint.py)
 
-def find_bare_excepts(source, filename):
-    try:
-        tree = ast.parse(source, filename=filename)
-    except SyntaxError as e:
-        raise RuntimeError("cannot parse {}: {}".format(filename, e))
-    return [
-        node.lineno
-        for node in ast.walk(tree)
-        if isinstance(node, ast.ExceptHandler) and node.type is None
-    ]
+find_bare_excepts = load_submodule("passes.legacy").find_bare_excepts
 
-
-def check(repo_root):
-    pkg_root = os.path.join(repo_root, PACKAGE)
-    findings = []
-    errors = []
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
-            try:
-                for lineno in find_bare_excepts(source, path):
-                    findings.append("{}/{}:{}".format(PACKAGE, rel, lineno))
-            except RuntimeError as e:
-                errors.append(str(e))
-    return findings, errors
+__all__ = ["find_bare_excepts", "main"]
 
 
 def main(argv=None):
-    repo_root = (argv or sys.argv[1:] or [None])[0] or os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
+    graftlint_main = load_submodule("__main__").main
+
+    repo_root = (argv or sys.argv[1:] or [None])[0] or REPO_ROOT
+    sys.stderr.write(
+        "check_no_bare_except: deprecated shim over graftlint's "
+        "no-bare-except rule (docs/static-analysis.md)\n"
     )
-    findings, errors = check(repo_root)
-    for err in errors:
-        sys.stderr.write(err + "\n")
-    for finding in findings:
-        sys.stderr.write(
-            "bare except outside policy: {} (name the exception type — "
-            "'except Exception:' at minimum)\n".format(finding)
-        )
-    if errors:
-        return 2
-    if findings:
-        return 1
-    sys.stderr.write("check_no_bare_except: OK\n")
-    return 0
+    return graftlint_main(["--root", repo_root, "--select", "no-bare-except"])
 
 
 if __name__ == "__main__":

@@ -1,94 +1,49 @@
 #!/usr/bin/env python
-"""Static check: no ``print(`` in the package outside the explicit allowlist.
+"""DEPRECATED shim: the no-print policy now lives in graftlint.
 
-Telemetry must flow through the registry/logger/emit layer — stray prints
-bypass the CloudWatch metric-definition contract and pollute the HPO stdout
-scrape surface. The allowlist names the files whose prints ARE a stdout
-contract:
+This script shipped in PR 1 as a standalone AST gate; the policy (and the
+allowlist) moved to the ``no-print`` rule of the repo's static analyzer
+(``sagemaker_xgboost_container_tpu/toolkit/graftlint``, see
+docs/static-analysis.md). The shim keeps the historical entrypoint and
+module API (``find_print_calls``, ``ALLOWLIST``) working for existing
+tox/ci.sh invocations and tests; new wiring should invoke the analyzer
+directly::
 
-* training/callbacks.py      — EvaluationMonitor HPO eval lines
-* training/algorithm_train.py — CV metric lines (same HPO regex contract)
-* version_contract.py        — CLI verdict for the image build
-* telemetry/emit.py          — the structured-record sink itself (uses
-  sys.stdout.write, listed defensively)
+    python scripts/graftlint.py --select no-print
 
-Detection is AST-based (calls to the ``print`` builtin), so strings and
-comments mentioning print() don't trip it. Exit 0 clean, 1 with findings,
-2 on unparseable files. Wired into tox (fast/full) and the tier-1 suite
-(tests/test_telemetry.py).
+(graftlint is loaded through ``scripts/graftlint.py`` rather than as a
+product submodule so the gate still reports — exit 2 — on a tree whose
+package ``__init__`` chain doesn't even import.)
+
+Exit codes unchanged: 0 clean, 1 with findings, 2 on unparseable files.
 """
 
-import ast
 import os
 import sys
 
-PACKAGE = "sagemaker_xgboost_container_tpu"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
 
-ALLOWLIST = {
-    "training/callbacks.py",
-    "training/algorithm_train.py",
-    "version_contract.py",
-    "telemetry/emit.py",
-}
+from graftlint import load_submodule  # noqa: E402  (scripts/graftlint.py)
 
+_legacy = load_submodule("passes.legacy")
+ALLOWLIST = _legacy.PRINT_ALLOWLIST
+find_print_calls = _legacy.find_print_calls
 
-def find_print_calls(source, filename):
-    try:
-        tree = ast.parse(source, filename=filename)
-    except SyntaxError as e:
-        raise RuntimeError("cannot parse {}: {}".format(filename, e))
-    calls = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            calls.append(node.lineno)
-    return calls
-
-
-def check(repo_root):
-    pkg_root = os.path.join(repo_root, PACKAGE)
-    findings = []
-    errors = []
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
-            if rel in ALLOWLIST:
-                continue
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
-            try:
-                for lineno in find_print_calls(source, path):
-                    findings.append("{}/{}:{}".format(PACKAGE, rel, lineno))
-            except RuntimeError as e:
-                errors.append(str(e))
-    return findings, errors
+__all__ = ["ALLOWLIST", "find_print_calls", "main"]
 
 
 def main(argv=None):
-    repo_root = (argv or sys.argv[1:] or [None])[0] or os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
+    graftlint_main = load_submodule("__main__").main
+
+    repo_root = (argv or sys.argv[1:] or [None])[0] or REPO_ROOT
+    sys.stderr.write(
+        "check_no_print: deprecated shim over graftlint's no-print rule "
+        "(docs/static-analysis.md)\n"
     )
-    findings, errors = check(repo_root)
-    for err in errors:
-        sys.stderr.write(err + "\n")
-    for finding in findings:
-        sys.stderr.write(
-            "print() outside allowlist: {} (route output through "
-            "telemetry.emit_metric or a logger)\n".format(finding)
-        )
-    if errors:
-        return 2
-    if findings:
-        return 1
-    sys.stderr.write("check_no_print: OK\n")
-    return 0
+    return graftlint_main(["--root", repo_root, "--select", "no-print"])
 
 
 if __name__ == "__main__":

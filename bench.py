@@ -52,9 +52,17 @@ _CONFIG_KEYS = (
 )
 
 
+# set when the accelerator-backend pre-check fails or wedges: every result
+# line carries the captured reason instead of silently reading "CPU" —
+# BASELINE.md: every TPU probe so far wedged at init with no recorded cause
+_backend_init_error = None
+
+
 def _emit(doc):
     """Print a result line immediately (stdout is the driver artifact; the
     last parseable line wins, so best-so-far lines are safe to emit)."""
+    if _backend_init_error and "backend_init_error" not in doc:
+        doc = dict(doc, backend_init_error=_backend_init_error)
     print(json.dumps(doc), flush=True)
 
 
@@ -92,17 +100,22 @@ def _run_child(env_extra, timeout):
 
 
 def _backend_healthy(timeout):
-    """Cheap pre-check: can the accelerator backend answer a tiny matmul
-    within `timeout`? A wedged tunnel hangs jax.devices() forever — pay 90s
-    here instead of a full probe budget per config.
+    """Cheap bounded pre-check: can the accelerator backend answer a tiny
+    matmul within `timeout`? A wedged tunnel hangs jax.devices() forever —
+    pay the bounded probe here instead of a full probe budget per config.
 
-    Returns ``(healthy, n_devices)`` — the device count decides whether the
-    GRAFT_HIST_COMM probe column is meaningful (collectives need a mesh)."""
+    Returns ``(healthy, n_devices, error)``: the device count decides
+    whether the GRAFT_HIST_COMM probe column is meaningful (collectives
+    need a mesh); ``error`` is None when healthy, else a dict with the
+    captured failure text and the elapsed probe seconds — recorded in the
+    BENCH JSON as ``backend_init_error`` so a wedged init finally leaves a
+    reason behind instead of a silent CPU fallback."""
     code = (
         "import jax, jax.numpy as j;"
         "print('DEVICES', len(jax.devices()));"
         "print(float((j.ones((128,128))@j.ones((128,128))).sum()))"
     )
+    t0 = time.monotonic()
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
@@ -110,13 +123,22 @@ def _backend_healthy(timeout):
             text=True,
             timeout=timeout,
         )
-        n_devices = 1
-        for line in r.stdout.splitlines():
-            if line.startswith("DEVICES "):
-                n_devices = int(line.split()[1])
-        return r.returncode == 0, n_devices
     except subprocess.TimeoutExpired:
-        return False, 0
+        return False, 0, {
+            "error": "backend init probe timed out (wedged tunnel?)",
+            "elapsed_s": round(time.monotonic() - t0, 1),
+        }
+    n_devices = 1
+    for line in r.stdout.splitlines():
+        if line.startswith("DEVICES "):
+            n_devices = int(line.split()[1])
+    if r.returncode == 0:
+        return True, n_devices, None
+    tail = " | ".join(r.stderr.strip().splitlines()[-3:])[-400:]
+    return False, n_devices, {
+        "error": "backend init probe rc={}: {}".format(r.returncode, tail),
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
 
 
 def _code_fingerprint():
@@ -376,12 +398,14 @@ def _supervised_main():
     want_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
     n_devices = 1
     if not want_cpu:
+        global _backend_init_error
         precheck_budget = int(os.getenv("BENCH_PRECHECK_TIMEOUT_S", "90"))
-        healthy, n_devices = _backend_healthy(precheck_budget)
+        healthy, n_devices, backend_err = _backend_healthy(precheck_budget)
         if not healthy:
+            _backend_init_error = backend_err
             sys.stderr.write(
-                "backend pre-check failed within {}s (wedged tunnel?)\n".format(
-                    precheck_budget
+                "backend pre-check failed within {}s: {}\n".format(
+                    precheck_budget, (backend_err or {}).get("error", "?")
                 )
             )
             _cpu_fallback(deadline, "backend pre-check failed/hung")
@@ -557,6 +581,7 @@ def main():
     # detect a dead accelerator backend up front; an honest, clearly-labeled
     # CPU number is more useful than a 0.0 placeholder
     backend_note = ""
+    backend_err = None
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -565,13 +590,28 @@ def main():
             jax.config.update("jax_platforms", "cpu")
         jax.devices()
     else:
+        t0 = time.monotonic()
         try:
             jax.devices()
         except RuntimeError as e:
             sys.stderr.write("TPU backend unavailable: {}\n".format(e))
+            backend_err = {
+                "error": str(e)[:400],
+                "elapsed_s": round(time.monotonic() - t0, 1),
+            }
             jax.config.update("jax_platforms", "cpu")
             jax.devices()
             backend_note = " [CPU FALLBACK - TPU backend unavailable]"
+
+    # attribution plumbing: the jax.monitoring compile listener feeds
+    # compile_stats, and SM_TRACE_DEVICE_SYNC=1 makes the session fence
+    # every dispatch so host_dispatch/device_sync phases are measured (the
+    # bench loop blocks per dispatch anyway, so the fence costs nothing)
+    os.environ.setdefault("SM_TRACE_DEVICE_SYNC", "1")
+    from sagemaker_xgboost_container_tpu.telemetry import register_runtime_gauges
+    from sagemaker_xgboost_container_tpu.telemetry.cluster import compile_stats
+
+    register_runtime_gauges()
 
     from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
     from sagemaker_xgboost_container_tpu.models.booster import (
@@ -642,12 +682,22 @@ def main():
 
     round_hist = REGISTRY.histogram(ROUND_HISTOGRAM, help="Boosting round wall time")
 
+    def _phase_sums():
+        sums = {}
+        for name, kind, _help, series in REGISTRY.collect():
+            if name == "training_phase_seconds" and kind == "histogram":
+                for metric in series:
+                    sums[metric.labels.get("phase", "unknown")] = metric.sum
+        return sums
+
     with span("warmup"):
         done = 0
         while done < WARMUP_ROUNDS:
             done += len(session.run_rounds()[0])
         jax.block_until_ready(session.margins)
 
+    warmup_compile_s = compile_stats()["seconds"]
+    pre_phases = _phase_sums()
     start = time.perf_counter()
     done = 0
     with span("measure"):
@@ -664,12 +714,34 @@ def main():
             done += n
     elapsed = time.perf_counter() - start
 
-    phases_ms = {}
-    for name, kind, _help, series in REGISTRY.collect():
-        if name == "training_phase_seconds" and kind == "histogram":
-            for metric in series:
-                phase = metric.labels.get("phase", "unknown")
-                phases_ms[phase] = round(metric.sum * 1000, 3)
+    post_phases = _phase_sums()
+    phases_ms = {k: round(v * 1000, 3) for k, v in post_phases.items()}
+
+    # attribution of the MEASURED window: compile (jax.monitoring listener
+    # delta; warmup compile reported separately — that's where first-round
+    # compile lives), host dispatch vs device compute (the per-dispatch
+    # fence spans), and the calibrated collective share on a mesh
+    def _delta(key):
+        return max(post_phases.get(key, 0.0) - pre_phases.get(key, 0.0), 0.0)
+
+    from sagemaker_xgboost_container_tpu.telemetry import get_round_fields
+    from sagemaker_xgboost_container_tpu.training.profiling import (
+        attribution_fields,
+    )
+
+    compile_ms = max(compile_stats()["seconds"] - warmup_compile_s, 0.0) * 1000
+    # a compile that fired inside a fenced dispatch is already inside the
+    # host_dispatch span — re-attribute like RoundTimer does
+    host_ms = max(_delta("host_dispatch") * 1000 - compile_ms, 0.0)
+    attribution = attribution_fields(
+        total_ms=elapsed * 1000.0,
+        compile_ms=compile_ms,
+        host_ms=host_ms,
+        device_ms=_delta("device_sync") * 1000,
+        collective_ms=float(get_round_fields().get("hist_comm_ms") or 0.0)
+        * done,
+    )
+    attribution["warmup_compile_ms"] = round(warmup_compile_s * 1000, 3)
 
     rounds_per_sec = done / elapsed
     shape_note = (
@@ -677,22 +749,22 @@ def main():
         if task == "lossguide"
         else "depth {}".format(MAX_DEPTH)
     )
-    print(
-        json.dumps(
-            {
-                "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, {}, {}{}){}".format(
-                    N_ROWS, N_FEATURES, shape_note, params["objective"],
-                    mesh_note, backend_note
-                ),
-                "value": round(rounds_per_sec, 3),
-                "unit": "rounds/sec",
-                "vs_baseline": round(rounds_per_sec / NORTH_STAR_ROUNDS_PER_SEC, 3),
-                "p50_ms": round(round_hist.quantile(0.5) * 1000, 3),
-                "p95_ms": round(round_hist.quantile(0.95) * 1000, 3),
-                "phases_ms": phases_ms,
-            }
-        )
-    )
+    doc = {
+        "metric": "boosting rounds/sec (synthetic, {} rows x {} feat, {}, {}{}){}".format(
+            N_ROWS, N_FEATURES, shape_note, params["objective"],
+            mesh_note, backend_note
+        ),
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rounds_per_sec / NORTH_STAR_ROUNDS_PER_SEC, 3),
+        "p50_ms": round(round_hist.quantile(0.5) * 1000, 3),
+        "p95_ms": round(round_hist.quantile(0.95) * 1000, 3),
+        "phases_ms": phases_ms,
+        "attribution": attribution,
+    }
+    if backend_err is not None:
+        doc["backend_init_error"] = backend_err
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

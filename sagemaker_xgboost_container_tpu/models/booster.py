@@ -626,6 +626,19 @@ class _TrainingSession:
         self._hist_comm_ms = None  # lazily calibrated at the first dispatch
         self._set_comm_round_fields()
 
+        # device-sync attribution sampling (SM_TRACE_DEVICE_SYNC = N):
+        # every Nth dispatch is split by a block_until_ready fence into a
+        # `host_dispatch` span (python + XLA dispatch until the async call
+        # returns) and a `device_sync` span (waiting on device compute) —
+        # the host/device split the flat round record can't see. Resolved
+        # ONCE here, host-side, like the hist knobs: the traced round path
+        # never reads env. 0 (default) means no fences, no spans.
+        from ..telemetry.tracing import DEVICE_SYNC_ENV
+        from ..utils.envconfig import env_int
+
+        self._device_sync_every = env_int(DEVICE_SYNC_ENV, 0, minimum=0)
+        self._dispatch_index = 0
+
         self._round_fn = self._make_round_fn()
         self._apply_fn = self._make_apply_fn()
 
@@ -1117,6 +1130,22 @@ class _TrainingSession:
             "collectives (ring formula, docs/DESIGN.md Communication)",
             labels,
         ).inc(self.hist_comm_bytes_per_round * k_rounds)
+        # trace the dispatch as a span under the open round span; the span
+        # duration is the calibrated isolated collective latency (0 until
+        # calibration lands) — an estimate, flagged as such in the attrs
+        from ..telemetry import tracing
+
+        if tracing.enabled():
+            tracing.record_span(
+                "collective.dispatch",
+                duration_s=(self._hist_comm_ms or 0.0) * k_rounds / 1000.0,
+                attributes={
+                    "impl": self.hist_comm,
+                    "bytes": self.hist_comm_bytes_per_round * k_rounds,
+                    "rounds": k_rounds,
+                    "calibrated": bool(self._hist_comm_ms),
+                },
+            )
 
     # ------------------------------------------------------------- resketch
     def _stage_train_bins(self, raw_bins, cuts, max_bin):
@@ -1215,6 +1244,41 @@ class _TrainingSession:
             )
 
     # ---------------------------------------------------------------- round
+    def _maybe_fenced_dispatch(self, dispatch):
+        """Run one round dispatch, attribution-fenced on every Nth call
+        (SM_TRACE_DEVICE_SYNC): the async XLA dispatch is timed as a
+        `host_dispatch` span and the wait on its outputs as `device_sync`.
+        The fence serializes host/device overlap, which is why it is
+        sampled, never always-on. Unsampled calls run ``dispatch`` as-is."""
+        sampled = (
+            self._device_sync_every > 0
+            and self._dispatch_index % self._device_sync_every == 0
+        )
+        self._dispatch_index += 1
+        if not sampled:
+            return dispatch()
+        from ..telemetry import active_recorder, compile_stats, span
+
+        pre_compile = compile_stats()["seconds"]
+        with span("host_dispatch"):
+            out = dispatch()
+        # an XLA compile that completed inside THIS dispatch is wall time
+        # the host_dispatch span already contains; RoundTimer reports it
+        # under the round's `compile` key, so remove exactly the measured
+        # overlap from the phase accumulator (and only then — a compile on
+        # an unfenced dispatch must not erode the sampled host time)
+        overlap = compile_stats()["seconds"] - pre_compile
+        if overlap > 0:
+            recorder = active_recorder()
+            if recorder is not None:
+                recorder.add("host_dispatch", -overlap)
+        with span("device_sync"):
+            # dispatch callables return every output they put in flight
+            # (round program + any separate eval-apply programs), so
+            # blocking the returned pytree fences the whole device step
+            jax.block_until_ready(out)
+        return out
+
     def run_rounds(self):
         """One device dispatch -> (list of host tree dicts, metrics or None).
 
@@ -1252,12 +1316,21 @@ class _TrainingSession:
             self.rank_index_dev,
         )
         if not self.use_scan_rounds:
-            packed, self.margins = self._round_fn(*args)
-            for i in range(len(self.eval_sets)):
-                if self.eval_margins[i] is not None:
-                    self.eval_margins[i] = self._apply_fn(
-                        packed, self.eval_bins[i], self.eval_margins[i]
-                    )
+
+            def _dispatch_single():
+                packed, self.margins = self._round_fn(*args)
+                for i in range(len(self.eval_sets)):
+                    if self.eval_margins[i] is not None:
+                        self.eval_margins[i] = self._apply_fn(
+                            packed, self.eval_bins[i], self.eval_margins[i]
+                        )
+                # return EVERY freshly dispatched output — the eval-margin
+                # applies are separate jitted programs, and the attribution
+                # fence must cover them too or their device time would leak
+                # into build_eval / the next round's host_dispatch
+                return packed, [m for m in self.eval_margins if m is not None]
+
+            packed, _fenced_evals = self._maybe_fenced_dispatch(_dispatch_single)
             self._note_comm_dispatch(1)
             return [unpack_tree(np.asarray(packed))], None
         eval_m = tuple(m for m in self.eval_margins if m is not None)
@@ -1266,8 +1339,8 @@ class _TrainingSession:
             for i in range(len(self.eval_bins))
             if self.eval_bins[i] is not None
         )
-        packed, metrics, self.margins, eval_m_out = self._round_fn(
-            *args, eval_m, eval_blw
+        packed, metrics, self.margins, eval_m_out = self._maybe_fenced_dispatch(
+            lambda: self._round_fn(*args, eval_m, eval_blw)
         )
         ei = 0
         for i in range(len(self.eval_margins)):

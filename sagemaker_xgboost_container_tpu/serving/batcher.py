@@ -20,7 +20,7 @@ import time
 import numpy as np
 
 from ..models.forest import _host_predict_rows
-from ..telemetry import POW2_BUCKETS, REGISTRY, get_request_id
+from ..telemetry import POW2_BUCKETS, REGISTRY, get_request_id, tracing
 from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
@@ -30,13 +30,16 @@ _LINGER_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.
 
 
 class _Pending:
-    __slots__ = ("features", "event", "result", "error")
+    __slots__ = ("features", "event", "result", "error", "ctx")
 
     def __init__(self, features):
         self.features = features
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # caller's trace context (SM_TRACE): carried across the queue so the
+        # worker's dispatch span joins the request's trace tree
+        self.ctx = tracing.current_context()
 
 
 class JobQueueFull(Exception):
@@ -160,50 +163,64 @@ class PredictBatcher:
                 if self._queue.empty() and self._carry is None:
                     self._m_requests.inc()
                     self._m_inline.inc()
-                    return np.asarray(self.predict_fn(feats))
+                    with tracing.trace_span(
+                        "batcher.inline",
+                        attributes={"rows": int(feats.shape[0])},
+                    ):
+                        return np.asarray(self.predict_fn(feats))
             finally:
                 self._exec_lock.release()
         pending = _Pending(feats)
+        # the queue span covers enqueue -> (result | rejection | timeout) on
+        # the caller's thread; the worker's dispatch span is its cross-thread
+        # sibling in the same trace (joined via pending.ctx)
+        qspan = tracing.start_span(
+            "batcher.queue", attributes={"rows": int(feats.shape[0])}
+        )
         try:
-            self._queue.put_nowait(pending)
-        except queue.Full:
-            self._m_rejected.inc()
-            with self._timeout_log_lock:
-                should_log, self._rejection_logged = not self._rejection_logged, True
-            if should_log:
-                logger.warning(
-                    "rejecting prediction (request %s): job queue full (%s "
-                    "pending). Further rejections are counted in "
-                    "batcher_rejected_total without logging.",
-                    get_request_id() or "untracked",
-                    self.max_queue,
+            try:
+                self._queue.put_nowait(pending)
+            except queue.Full:
+                self._m_rejected.inc()
+                with self._timeout_log_lock:
+                    should_log, self._rejection_logged = not self._rejection_logged, True
+                if should_log:
+                    logger.warning(
+                        "rejecting prediction (request %s): job queue full (%s "
+                        "pending). Further rejections are counted in "
+                        "batcher_rejected_total without logging.",
+                        get_request_id() or "untracked",
+                        self.max_queue,
+                    )
+                raise JobQueueFull(
+                    "job queue full ({} pending)".format(self.max_queue)
                 )
-            raise JobQueueFull(
-                "job queue full ({} pending)".format(self.max_queue)
-            )
-        self._m_requests.inc()
-        self._m_queue_depth.set(self._queue.qsize())
-        if not pending.event.wait(timeout):
-            # zombie pending: this caller gives up, but the worker still holds
-            # the _Pending and may dispatch its rows later — wasted compute
-            # that a timeout storm multiplies. Count every one; log the first
-            # at WARNING so the storm is visible without flooding the log.
-            self._m_timeouts.inc()
-            with self._timeout_log_lock:
-                should_log, self._timeout_logged = not self._timeout_logged, True
-            if should_log:
-                logger.warning(
-                    "prediction (request %s) timed out after %.1fs in the "
-                    "batch queue; the batch worker may still dispatch the "
-                    "abandoned rows. Further timeouts are counted in "
-                    "batcher_queue_timeout_total without logging.",
-                    get_request_id() or "untracked",
-                    timeout,
-                )
-            raise TimeoutError("prediction timed out in the batch queue")
-        if pending.error is not None:
-            raise pending.error
-        return pending.result
+            self._m_requests.inc()
+            self._m_queue_depth.set(self._queue.qsize())
+            if not pending.event.wait(timeout):
+                # zombie pending: this caller gives up, but the worker still
+                # holds the _Pending and may dispatch its rows later — wasted
+                # compute that a timeout storm multiplies. Count every one;
+                # log the first at WARNING so the storm is visible without
+                # flooding the log.
+                self._m_timeouts.inc()
+                with self._timeout_log_lock:
+                    should_log, self._timeout_logged = not self._timeout_logged, True
+                if should_log:
+                    logger.warning(
+                        "prediction (request %s) timed out after %.1fs in the "
+                        "batch queue; the batch worker may still dispatch the "
+                        "abandoned rows. Further timeouts are counted in "
+                        "batcher_queue_timeout_total without logging.",
+                        get_request_id() or "untracked",
+                        timeout,
+                    )
+                raise TimeoutError("prediction timed out in the batch queue")
+            if pending.error is not None:
+                raise pending.error
+            return pending.result
+        finally:
+            tracing.finish_span(qspan)
 
     # ------------------------------------------------------------------ int
     def _drain_batch(self, first, wait):
@@ -273,24 +290,38 @@ class PredictBatcher:
                 )
                 if len(batch) > 1:
                     self._m_coalesced.inc(len(batch))
-                try:
-                    # chaos hook: a sleep here wedges the dispatch worker
-                    # (tunneled-TPU stall), backing the queue up into
-                    # JobQueueFull — the breaker drill's saturation source
-                    fault_point("batcher.dispatch", requests=len(batch))
-                    stacked = (
-                        batch[0].features
-                        if len(batch) == 1
-                        else np.concatenate([p.features for p in batch], axis=0)
-                    )
-                    out = np.asarray(self.predict_fn(stacked))
-                    offset = 0
-                    for pending in batch:
-                        k = pending.features.shape[0]
-                        pending.result = out[offset : offset + k]
-                        offset += k
-                        pending.event.set()
-                except Exception as e:  # propagate to every caller in batch
-                    for pending in batch:
-                        pending.error = e
-                        pending.event.set()
+                # worker-thread dispatch span, parented to the first traced
+                # request in the batch so its trace id survives the thread
+                # hop (coalesced peers are named in the args)
+                ctx = next((p.ctx for p in batch if p.ctx is not None), None)
+                with tracing.trace_span(
+                    "batcher.dispatch",
+                    parent=ctx,
+                    attributes={
+                        "requests": len(batch),
+                        "rows": sum(p.features.shape[0] for p in batch),
+                    },
+                ):
+                    try:
+                        # chaos hook: a sleep here wedges the dispatch worker
+                        # (tunneled-TPU stall), backing the queue up into
+                        # JobQueueFull — the breaker drill's saturation source
+                        fault_point("batcher.dispatch", requests=len(batch))
+                        stacked = (
+                            batch[0].features
+                            if len(batch) == 1
+                            else np.concatenate(
+                                [p.features for p in batch], axis=0
+                            )
+                        )
+                        out = np.asarray(self.predict_fn(stacked))
+                        offset = 0
+                        for pending in batch:
+                            k = pending.features.shape[0]
+                            pending.result = out[offset : offset + k]
+                            offset += k
+                            pending.event.set()
+                    except Exception as e:  # propagate to every caller in batch
+                        for pending in batch:
+                            pending.error = e
+                            pending.event.set()

@@ -14,10 +14,12 @@ Import surface for the rest of the container:
 See docs/observability.md for the full metric catalogue and env knobs.
 """
 
+from . import tracing  # noqa: F401  (hierarchical tracer: telemetry.tracing)
 from .cluster import (  # noqa: F401
     CLUSTER_METRICS_ENV,
     HEARTBEAT_INTERVAL_ENV,
     ROUND_STATE,
+    compile_stats,
     refresh_runtime_gauges,
     register_runtime_gauges,
     start_cluster_telemetry,

@@ -43,6 +43,7 @@ import time
 
 from ..parallel.distributed import frame_message
 from ..utils.envconfig import env_float, env_int
+from . import tracing
 from .emit import emit_metric
 from .registry import REGISTRY, percentile
 
@@ -166,6 +167,10 @@ def _on_jax_duration_event(event, duration, **_kwargs):
     REGISTRY.counter(
         "xla_compile_seconds_total", help="Cumulative XLA backend compile time"
     ).inc(float(duration))
+    # with tracing armed, the compile becomes a span too: it lands under
+    # whatever span is open on the dispatching thread (the round span for a
+    # first-round compile), so compile time stops masquerading as build_eval
+    tracing.record_compile(float(duration))
 
 
 def register_runtime_gauges():

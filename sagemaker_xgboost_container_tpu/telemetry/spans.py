@@ -15,12 +15,19 @@ Three consumers, one call site:
 
 Recorders are thread-local: the booster's callback loop is single-threaded,
 and parallel serving threads never share a recorder by accident.
+
+With hierarchical tracing armed (``SM_TRACE``, telemetry/tracing.py) every
+``span()`` additionally opens a tracer span, so existing call sites upgrade
+in place: the flat per-round phases become children of the per-round root
+span RoundTimer owns. Disabled (the default), the only added cost is one
+cached-boolean check.
 """
 
 import contextlib
 import threading
 import time
 
+from . import tracing
 from .emit import emit_metric
 from .registry import REGISTRY
 
@@ -77,11 +84,14 @@ def span(name, emit=False, registry=None):
     ``training.phase`` stdout record — use it for one-off phases, never for
     per-round work (the round record owns that).
     """
+    tspan = tracing.start_span(name) if tracing.enabled() else None
     start = time.perf_counter()
     try:
         yield
     finally:
         elapsed = time.perf_counter() - start
+        if tspan is not None:
+            tracing.finish_span(tspan)
         (registry or REGISTRY).histogram(
             PHASE_HISTOGRAM,
             help="Wall time of named training phases",

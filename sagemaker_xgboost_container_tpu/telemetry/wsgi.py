@@ -22,6 +22,7 @@ import http.client
 import time
 
 from ..utils.envconfig import env_bool
+from . import tracing
 from .correlation import (
     REQUEST_ID_HEADER,
     clear_request_id,
@@ -130,6 +131,18 @@ def instrument_wsgi(app, registry=None):
         captured = {}
         request_id = extract_request_id(environ)
         set_request_id(request_id)
+        # with tracing armed, the request is a trace whose id IS the
+        # correlation id (honored or generated — echoed back either way),
+        # so the exported tree joins on the X-Request-Id the client saw;
+        # batcher spans on the worker thread carry the same trace id
+        tspan = None
+        if tracing.enabled():
+            tspan = tracing.start_span(
+                "http.request",
+                trace_id=request_id,
+                root=True,
+                attributes={"route": route, "method": method},
+            )
 
         def recording_start_response(status, headers, exc_info=None):
             captured["status"] = status
@@ -152,6 +165,10 @@ def instrument_wsgi(app, registry=None):
             _counter(route, "5xx").inc()
             raise
         finally:
+            if tspan is not None:
+                tracing.finish_span(
+                    tspan, status=str(captured.get("status", "")).split(" ")[0]
+                )
             clear_request_id()
         elapsed = time.perf_counter() - start
 

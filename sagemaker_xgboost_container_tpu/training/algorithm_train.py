@@ -176,6 +176,11 @@ def sagemaker_train(
             from .consensus import register_cluster
 
             register_cluster(participating_hosts, current_host)
+            # trace export files are per-rank (trace-rank<r>.json); the rank
+            # follows the re-formed cluster like everything above
+            from ..telemetry import tracing
+
+            tracing.set_rank(sorted(participating_hosts).index(current_host))
 
         distributed.distributed_run(
             exec_fun=train_job,
@@ -553,6 +558,17 @@ def train_job(
                     logger.debug(
                         "Stored trained model %d at %s", fold, model_location
                     )
+
+    # end-of-run trace export (SM_TRACE): one Chrome-trace file per rank
+    # into SM_TRACE_EXPORT_DIR, defaulting alongside the model artifacts so
+    # it travels in the output tarball. Best-effort — a failed export must
+    # never fail a finished job.
+    from ..telemetry import tracing
+
+    try:
+        tracing.export_traces(default_dir=model_dir)
+    except Exception:
+        logger.exception("trace export failed; training result unaffected")
 
 
 def _try_parallel_cv(

@@ -149,6 +149,18 @@ class ConsensusGuard:
     def after_iteration(self, model, epoch, evals_log):
         if (epoch + 1) % self.every != 0:
             return False
+        # tracer span (SM_TRACE): the digest + allgather as one tree node
+        # under the round span — a consensus check stalled on a slow peer
+        # is visible in the timeline (and in the flight recorder, since an
+        # exit-81 abort leaves this span in_flight)
+        from ..telemetry.tracing import trace_span
+
+        with trace_span(
+            "consensus.check", attributes={"round": epoch, "rank": self.rank}
+        ):
+            return self._check(model, epoch)
+
+    def _check(self, model, epoch):
         digest = forest_digest(model)
         try:
             fault_point("consensus.check", round=epoch, rank=self.rank)

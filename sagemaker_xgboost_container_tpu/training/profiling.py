@@ -24,10 +24,12 @@ import time
 from ..telemetry import (
     REGISTRY,
     ROUND_STATE,
+    compile_stats,
     emit_metric,
     get_round_fields,
     pop_recorder,
     push_recorder,
+    tracing,
 )
 from ..telemetry import percentile  # noqa: F401  (canonical home: telemetry.registry)
 from ..utils.faults import fault_point
@@ -59,6 +61,10 @@ class RoundTimer:
         self._last = None
         self._times = []
         self._recorder = None
+        self._round_span = None
+        self._compile_base = None
+        self._compile_total_s = 0.0
+        self._phase_totals = {}
 
     def before_training(self, model):
         self._last = time.perf_counter()
@@ -66,6 +72,17 @@ class RoundTimer:
         # popped in after_training. Thread-local, so parallel fold loops on
         # other threads never cross-talk.
         self._recorder = push_recorder()
+        # per-round compile accounting: XLA compiles completed during a
+        # round (the jax.monitoring listener feeds compile_stats) become a
+        # `compile` phase key instead of silently inflating build_eval
+        self._compile_base = compile_stats()["seconds"]
+        self._compile_total_s = 0.0
+        self._phase_totals = {}
+        if tracing.enabled():
+            # per-round ROOT span: stays open for the whole round, so the
+            # phase spans (checkpoint, consensus, eval_monitor, ...) and the
+            # booster's dispatch/collective/compile spans nest under it
+            self._round_span = tracing.start_span("round")
         return model
 
     def after_iteration(self, model, epoch, evals_log):
@@ -84,16 +101,37 @@ class RoundTimer:
             # a deque append under a lock — negligible, so always on
             ROUND_STATE.note_round(epoch, elapsed)
             phases = self._recorder.drain() if self._recorder is not None else {}
+            compile_now = compile_stats()["seconds"]
+            compile_delta = (
+                max(compile_now - self._compile_base, 0.0)
+                if self._compile_base is not None
+                else 0.0
+            )
+            self._compile_base = compile_now
+            self._compile_total_s += compile_delta
+            # NOTE: a compile that completes inside a fenced dispatch is
+            # already subtracted from the host_dispatch phase at the source
+            # (booster._maybe_fenced_dispatch measures the exact overlap),
+            # so compile + host_dispatch + build_eval sum without double
+            # counting; values only clamp here against float noise
+            for name, seconds in phases.items():
+                self._phase_totals[name] = (
+                    self._phase_totals.get(name, 0.0) + seconds
+                )
             if self.emit_structured:
-                # callback work is measured by its spans; the remainder of the
-                # round is device compute: binning (first round), tree build,
-                # eval. One record per round — the CloudWatch-regex contract.
+                # callback work is measured by its spans; XLA compiles that
+                # completed this round get their own key; the remainder is
+                # device compute: binning (first round), tree build, eval.
+                # One record per round — the CloudWatch-regex contract.
                 overhead = sum(phases.values())
                 phases_ms = {
-                    k: round(v * 1000, 3) for k, v in sorted(phases.items())
+                    k: round(max(v, 0.0) * 1000, 3)
+                    for k, v in sorted(phases.items())
                 }
+                if compile_delta > 0:
+                    phases_ms["compile"] = round(compile_delta * 1000, 3)
                 phases_ms["build_eval"] = round(
-                    max(elapsed - overhead, 0.0) * 1000, 3
+                    max(elapsed - overhead - compile_delta, 0.0) * 1000, 3
                 )
                 fields = {
                     "round": epoch,
@@ -117,10 +155,20 @@ class RoundTimer:
                         self.num_rows / mean / 1e6
                     )
                 logger.info(msg)
+        if self._round_span is not None:
+            # RoundTimer is last in the callback stack, so every phase span
+            # of round `epoch` has already closed under this span; rotate
+            tracing.finish_span(self._round_span, round=epoch)
+            self._round_span = tracing.start_span("round")
         self._last = now
         return False
 
     def after_training(self, model):
+        if self._round_span is not None:
+            # the span opened after the last round covers post-training
+            # callback work (final checkpoint flush, early-stopping trim)
+            tracing.finish_span(self._round_span, tail=True)
+            self._round_span = None
         if self._recorder is not None:
             pop_recorder(self._recorder)
             self._recorder = None
@@ -149,21 +197,94 @@ class RoundTimer:
                 if self.fold is not None:
                     fields["fold"] = self.fold
                 emit_metric("training.summary", **fields)
+                self._emit_attribution(total)
         return model
+
+    def _emit_attribution(self, total_s):
+        """One ``training.attribution`` record: where the run's wall time
+        went — XLA compile (the jax.monitoring listener), host dispatch /
+        device compute (the SM_TRACE_DEVICE_SYNC sampling spans), and the
+        calibrated histogram collectives. Fields are 0.0 when the matching
+        instrumentation wasn't armed, so the record shape is stable."""
+        comm_per_round = get_round_fields().get("hist_comm_ms") or 0.0
+        fields = attribution_fields(
+            total_ms=total_s * 1000.0,
+            compile_ms=self._compile_total_s * 1000.0,
+            host_ms=max(self._phase_totals.get("host_dispatch", 0.0), 0.0)
+            * 1000.0,
+            device_ms=self._phase_totals.get("device_sync", 0.0) * 1000.0,
+            collective_ms=float(comm_per_round) * len(self._times),
+        )
+        fields["rounds"] = len(self._times)
+        if self.fold is not None:
+            fields["fold"] = self.fold
+        emit_metric("training.attribution", **fields)
+
+
+def attribution_fields(total_ms, compile_ms, host_ms, device_ms, collective_ms):
+    """The shared compile/host/device/collective attribution shape — stable
+    keys for CloudWatch regexes, used by both the ``training.attribution``
+    record and bench.py's ``attribution`` section. Percentages are shares of
+    ``total_ms`` (0.0 when the window is empty)."""
+
+    def pct(ms):
+        return round(ms / total_ms * 100.0, 1) if total_ms > 0 else 0.0
+
+    return {
+        "total_ms": round(total_ms, 3),
+        "compile_ms": round(compile_ms, 3),
+        "host_ms": round(host_ms, 3),
+        "device_ms": round(device_ms, 3),
+        "collective_ms": round(collective_ms, 3),
+        "compile_pct": pct(compile_ms),
+        "host_pct": pct(host_ms),
+        "device_pct": pct(device_ms),
+        "collective_pct": pct(collective_ms),
+    }
 
 
 @contextlib.contextmanager
 def xla_trace():
-    """Capture a JAX profiler trace when SM_PROFILER_TRACE_DIR is set."""
+    """Capture a JAX profiler trace when SM_PROFILER_TRACE_DIR is set.
+
+    Hardened: the trace is diagnostics, never a correctness dependency — the
+    directory is created when missing, and a profiler that refuses to start
+    (already-active session, unwritable volume) or to stop logs a warning
+    and lets training proceed/finish. A successful capture emits one
+    ``training.trace`` record carrying the output path, so the artifact is
+    discoverable from the job log alone.
+    """
     trace_dir = os.environ.get(TRACE_DIR_ENV)
     if not trace_dir:
         yield
         return
     import jax
 
-    jax.profiler.start_trace(trace_dir)
+    started = False
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception as e:
+        logger.warning(
+            "could not start XLA profiler trace in %s (%s); training "
+            "continues untraced",
+            trace_dir,
+            e,
+        )
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
-        logger.info("Wrote XLA profiler trace to %s", trace_dir)
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                logger.warning(
+                    "XLA profiler stop_trace failed (%s); trace in %s may "
+                    "be incomplete",
+                    e,
+                    trace_dir,
+                )
+            else:
+                logger.info("Wrote XLA profiler trace to %s", trace_dir)
+                emit_metric("training.trace", trace_dir=trace_dir)

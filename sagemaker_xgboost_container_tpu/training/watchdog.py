@@ -37,6 +37,7 @@ import threading
 import time
 
 from ..constants import EXIT_CLUSTER_ABORT, EXIT_ROUND_DEADLINE
+from ..constants import SM_MODEL_DIR as SM_MODEL_DIR_ENV
 from ..telemetry.emit import emit_metric
 from ..utils.envconfig import env_bool, env_float
 from . import checkpointing
@@ -82,6 +83,30 @@ def request_abort(reason, exit_code, **fields):
         checkpointing.flush_checkpoints()
     except Exception:
         logger.exception("checkpoint flush during abort failed; exiting anyway")
+    # post-mortem for the hung round: dump the flight recorder (last-N
+    # finished spans + every still-open span, incl. the wedged round /
+    # collective / consensus check) before the hard exit. SM_TRACE gated
+    # and internally fail-safe — a broken disk cannot block the exit.
+    # Without an explicit SM_TRACE_EXPORT_DIR the dump lands in a durable,
+    # platform-uploaded location — the live checkpoint dir (same place the
+    # flush above just settled), else the model dir — never only in a cwd
+    # that dies with the container.
+    try:
+        from ..telemetry import tracing
+
+        dump_dir = None
+        dirs = checkpointing.active_checkpoint_dirs()
+        if dirs:
+            dump_dir = dirs[0]
+        else:
+            dump_dir = os.environ.get(SM_MODEL_DIR_ENV) or None
+        dump_path = tracing.dump_flight_recorder(
+            default_dir=dump_dir, reason=reason, exit_code=exit_code
+        )
+        if dump_path:
+            fields = dict(fields, flight_recorder=dump_path)
+    except Exception:
+        logger.exception("flight-recorder dump failed; exiting anyway")
     emit_metric("training.abort", reason=reason, exit_code=exit_code, **fields)
     _exit(exit_code)
 

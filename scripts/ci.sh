@@ -67,5 +67,18 @@ case "$TIER" in
     ;;
 esac
 rc=$?
+
+# trace-export smoke (fast/full): train a tiny model with SM_TRACE=1 and
+# archive the exported Chrome trace alongside graftlint.json — every CI run
+# leaves a loadable round timeline artifact (docs/observability.md §Tracing)
+if [ $rc -eq 0 ] && [ "$TIER" != "chaos" ]; then
+  if python "$REPO/scripts/trace_smoke.py" "$ARTIFACT_DIR/traces"; then
+    echo "trace smoke: OK (artifact: $ARTIFACT_DIR/traces)"
+  else
+    rc=1
+    echo "CI $TIER TIER FAILED (trace smoke; see $ARTIFACT_DIR/traces)"
+  fi
+fi
+
 [ $rc -eq 0 ] && echo "CI $TIER TIER OK" || echo "CI $TIER TIER FAILED (rc=$rc)"
 exit $rc

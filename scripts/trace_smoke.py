@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Trace-export smoke: train a tiny model with tracing armed and export the
+Chrome trace, validating the artifact before CI archives it.
+
+``scripts/ci.sh`` runs this after the test tiers and archives the exported
+JSON under ``${CI_ARTIFACT_DIR:-.ci-artifacts}/traces/`` next to
+``graftlint.json`` — every CI run leaves a real, loadable timeline behind
+(chrome://tracing / Perfetto), so "what does a round look like right now"
+is answerable from artifacts alone.
+
+Exit codes: 0 OK, 1 the export is missing/empty/not a span tree.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SM_TRACE"] = "1"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_dir = argv[0] if argv else os.path.join(".ci-artifacts", "traces")
+    os.environ["SM_TRACE_EXPORT_DIR"] = out_dir
+    # sample every dispatch so the artifact carries the host/device split
+    os.environ.setdefault("SM_TRACE_DEVICE_SYNC", "1")
+
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.telemetry import (
+        register_runtime_gauges,
+        tracing,
+    )
+    from sagemaker_xgboost_container_tpu.training.profiling import RoundTimer
+
+    register_runtime_gauges()
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    train(
+        {"objective": "binary:logistic", "max_depth": 3},
+        DataMatrix(X, labels=y),
+        num_boost_round=3,
+        callbacks=[RoundTimer(num_rows=256, log_every=0, emit_structured=False)],
+    )
+    path = tracing.export_traces(default_dir=out_dir)
+    if not path or not os.path.isfile(path):
+        sys.stderr.write("trace smoke FAILED: no export file produced\n")
+        return 1
+    with open(path) as f:
+        doc = json.load(f)
+    spans = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    rounds = [e for e in spans if e["name"] == "round"]
+    if not rounds:
+        sys.stderr.write(
+            "trace smoke FAILED: {} has no round spans ({} events)\n".format(
+                path, len(spans)
+            )
+        )
+        return 1
+    print(
+        "trace smoke OK: {} ({} spans, {} rounds)".format(
+            path, len(spans), len(rounds)
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

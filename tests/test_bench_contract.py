@@ -189,7 +189,7 @@ def test_supervised_winner_path_skips_probes(tmp_path, monkeypatch, capsys):
         3.5,
         "seed",
     )
-    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (True, 1))
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (True, 1, None))
     monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.delenv("BENCH_REPROBE", raising=False)
@@ -228,7 +228,7 @@ def test_supervised_stale_winner_reprobes(tmp_path, monkeypatch, capsys):
             }
         )
     )
-    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (True, 1))
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (True, 1, None))
     monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.delenv("BENCH_REPROBE", raising=False)
@@ -255,7 +255,7 @@ def test_supervised_failed_winner_reprobes(tmp_path, monkeypatch, capsys):
         3.5,
         "seed",
     )
-    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (True, 1))
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (True, 1, None))
     monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.delenv("BENCH_REPROBE", raising=False)
@@ -280,7 +280,7 @@ def test_supervised_wedged_precheck_goes_straight_to_cpu(monkeypatch, capsys):
     """A failed backend pre-check must skip every TPU probe and produce the
     labeled CPU fallback immediately."""
     bench = _load_bench()
-    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (False, 0))
+    monkeypatch.setattr(bench, "_backend_healthy", lambda t: (False, 0, {"error": "probe timed out", "elapsed_s": 90.0}))
     monkeypatch.delenv("GRAFT_HIST_IMPL", raising=False)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     calls = []

@@ -149,3 +149,4 @@ ONE_THREAD_PER_PROCESS = "1"
 EXIT_ROUND_DEADLINE = 79  # round watchdog: a boosting round exceeded its deadline
 EXIT_CLUSTER_ABORT = 80   # coordinated abort: rank 0 declared a peer dead
 EXIT_CONSENSUS_DIVERGENCE = 81  # cross-rank tree-digest guard: ranks committed different ensembles
+EXIT_REFORM_FAILED = 82   # elastic shrink: survivor re-rendezvous failed; restart at the old membership

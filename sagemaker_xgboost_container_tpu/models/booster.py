@@ -259,7 +259,15 @@ class _TrainingSession:
     """Device state for one training run (bins, margins, jitted round fns)."""
 
     def __init__(
-        self, config, dtrain, evals, forest, mesh=None, metric_names=None, has_feval=False
+        self,
+        config,
+        dtrain,
+        evals,
+        forest,
+        mesh=None,
+        metric_names=None,
+        has_feval=False,
+        hist_knobs=None,
     ):
         self.config = config
         self.objective = forest.objective()
@@ -282,8 +290,11 @@ class _TrainingSession:
         self.hist_comm = hist_comm_impl() if mesh is not None else "psum"
         # every other histogram/scan/routing knob, snapshotted host-side for
         # the same reason (trace-safety: graftlint trace-env-read forbids
-        # env reads in the traced build path) and threaded into the builders
-        self.hist_knobs = resolve_hist_knobs()
+        # env reads in the traced build path) and threaded into the builders.
+        # Callers may inject a snapshot: an elastic membership reform rebuilds
+        # the session on a smaller mesh but MUST train under the same knobs
+        # as the generation it resumes (no mid-job env drift).
+        self.hist_knobs = hist_knobs if hist_knobs is not None else resolve_hist_knobs()
         if self.hist_comm == "reduce_scatter" and self.has_feature_axis:
             # reduce_scatter re-shards the SPLIT SCAN over the data axis;
             # with a feature axis the scan is already column-sharded and the
@@ -1588,12 +1599,17 @@ def train(
     xgb_model=None,
     verbose_eval=True,
     mesh=None,
+    hist_knobs=None,
 ):
     """Train a Forest. API mirrors ``xgb.train`` for the orchestration layer.
 
     xgb_model: a Forest or a model-file path to continue training from
     (checkpoint resume — reference checkpointing.py:45-55).
     mesh: optional jax Mesh with a "data" axis for multi-chip data parallelism.
+    hist_knobs: optional pre-resolved histogram-knob snapshot (ops/histogram
+    HistKnobs); an elastic membership reform passes the original session's
+    snapshot so the rebuilt (smaller-mesh) session trains under identical
+    kernel choices.
     """
     config = TrainConfig(params)
     callbacks = list(callbacks or [])
@@ -1678,6 +1694,7 @@ def train(
         mesh=mesh,
         metric_names=metric_names,
         has_feval=feval is not None,
+        hist_knobs=hist_knobs,
     )
 
     for cb in callbacks:

@@ -29,7 +29,7 @@ import threading
 import time
 
 from ..toolkit import exceptions as exc
-from ..utils.envconfig import env_float, env_int
+from ..utils.envconfig import env_float, env_port
 from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
@@ -273,7 +273,7 @@ class Cluster:
 
 # --------------------------------------------------------------- abort plane
 def abort_port():
-    return env_int(ABORT_PORT_ENV, DEFAULT_ABORT_PORT, minimum=1, maximum=65535)
+    return env_port(ABORT_PORT_ENV, DEFAULT_ABORT_PORT)
 
 
 class AbortListener:
@@ -299,6 +299,13 @@ class AbortListener:
         self._server.settimeout(0.2)
         self.port = self._server.getsockname()[1]
         self._stop = threading.Event()
+        # duplicate-frame suppression: two ranks detecting the same dead
+        # host each broadcast the same frame; the handler must fire once
+        # per distinct frame, and racing deliveries must serialize (the
+        # dispatch lock) so conflicting exit codes resolve first-wins
+        # rather than interleaving
+        self._dispatch_lock = threading.Lock()
+        self._seen_frames = set()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="abort-listener"
         )
@@ -309,7 +316,8 @@ class AbortListener:
 
     def stop(self, timeout=5.0):
         self._stop.set()
-        self._thread.join(timeout)
+        if self._thread.ident is not None:  # never-started listeners close clean
+            self._thread.join(timeout)
         try:
             self._server.close()
         except OSError:
@@ -333,40 +341,87 @@ class AbortListener:
                     conn.close()
                 except OSError:
                     pass
-            if isinstance(msg, dict) and msg.get("type") == "abort":
-                logger.error(
-                    "abort frame received from %s (reason: %s)",
-                    msg.get("source", addr[0]),
-                    msg.get("reason", "unspecified"),
-                )
-                try:
-                    self.handler(msg)
-                except Exception:
-                    logger.exception("abort handler failed")
-            else:
-                logger.warning("abort listener: ignoring non-abort frame from %s", addr)
+            self._dispatch(msg, addr)
         try:
             self._server.close()
         except OSError:
             pass
 
+    def _dispatch(self, msg, addr):
+        """Hand one decoded frame to the handler — idempotently.
 
-def broadcast_abort(hosts, reason, source=None, port=None, timeout=2.0, exit_code=None):
+        Two ranks detecting the same dead host broadcast frames that differ
+        only in ``source``; the event key drops it, so the second delivery
+        is a logged no-op instead of a double-fired abort/shrink. Distinct
+        frames (a later shrink generation, a different reason) still pass.
+        Dispatch is serialized under a lock so racing deliveries can't
+        interleave — the first frame's verdict (exit code, survivor set)
+        settles before the next is even considered.
+        """
+        if not (isinstance(msg, dict) and msg.get("type") == "abort"):
+            logger.warning("abort listener: ignoring non-abort frame from %s", addr)
+            return False
+        key = json.dumps(
+            {k: v for k, v in msg.items() if k != "source"}, sort_keys=True
+        )
+        with self._dispatch_lock:
+            if key in self._seen_frames:
+                logger.info(
+                    "abort listener: duplicate %s frame from %s suppressed "
+                    "(already handled)",
+                    msg.get("verb", "abort"),
+                    msg.get("source", addr[0]),
+                )
+                return False
+            self._seen_frames.add(key)
+            logger.error(
+                "%s frame received from %s (reason: %s)",
+                msg.get("verb", "abort"),
+                msg.get("source", addr[0]),
+                msg.get("reason", "unspecified"),
+            )
+            try:
+                self.handler(msg)
+            except Exception:
+                logger.exception("abort handler failed")
+            return True
+
+
+def broadcast_abort(
+    hosts,
+    reason,
+    source=None,
+    port=None,
+    timeout=2.0,
+    exit_code=None,
+    extra=None,
+    peer_addrs=None,
+):
     """Best-effort abort fan-out: one framed message per host, bounded
     connect/send timeouts, failures logged not raised (a host that's
     already dead is exactly why we're broadcasting). Returns the number of
     hosts the frame was delivered to. ``exit_code`` (when given) rides in
     the frame so receivers exit with the broadcaster's distinguishing code
-    (watchdog._frame_exit_code bounds it receiver-side)."""
-    target_port = abort_port() if port is None else port
+    (watchdog._frame_exit_code bounds it receiver-side). ``extra`` fields
+    merge into the frame — the elastic plane rides a ``verb: "shrink"`` plus
+    the survivor set here instead of inventing a second control channel.
+    ``peer_addrs`` optionally maps a host to its ``(addr, port)`` pair
+    (loopback drills, where every "host" is 127.0.0.1 on a distinct port);
+    unmapped hosts resolve by name on the default port."""
+    default_port = abort_port() if port is None else port
     frame = {"type": "abort", "reason": reason, "source": source}
     if exit_code is not None:
         frame["exit_code"] = int(exit_code)
+    if extra:
+        frame.update(extra)
     delivered = 0
     for host in hosts:
+        addr, target_port = (peer_addrs or {}).get(host, (host, None))
+        if target_port is None:
+            target_port = default_port
         fault_point("abort.broadcast", host=host)
         try:
-            sock = socket.create_connection((host, target_port), timeout=timeout)
+            sock = socket.create_connection((addr, target_port), timeout=timeout)
             try:
                 sock.settimeout(timeout)
                 sock.sendall(frame_message(frame))
@@ -374,8 +429,82 @@ def broadcast_abort(hosts, reason, source=None, port=None, timeout=2.0, exit_cod
             finally:
                 sock.close()
         except OSError as e:
-            logger.warning("abort broadcast to %s:%d failed: %s", host, target_port, e)
+            logger.warning("abort broadcast to %s:%d failed: %s", addr, target_port, e)
     return delivered
+
+
+REFORM_PORT_ENV = "SM_REFORM_PORT"
+# NOT the rendezvous (9099), heartbeat (9199), abort (9299), or consensus
+# (9399) ports: survivors re-rendezvous while the dead host's half-open
+# conversations on those ports may still be draining
+DEFAULT_REFORM_PORT = 9499
+
+
+def reform_port():
+    return env_port(REFORM_PORT_ENV, DEFAULT_REFORM_PORT)
+
+
+def reform_cluster(
+    survivors,
+    current_host,
+    generation,
+    payload=None,
+    port=None,
+    timeout=60.0,
+    master_addr=None,
+):
+    """Survivor re-rendezvous: one bounded allgather over the shrunken host
+    list -> (new Cluster, rank-ordered membership payloads).
+
+    The elastic-membership analog of the startup handshake: every survivor
+    runs the same retried, deadline-bounded ``Cluster.synchronize`` on the
+    dedicated reform port (``SM_REFORM_PORT``), exchanging
+    ``{host, generation, ...payload}``. The handshake retries through
+    ``utils.retry`` (site ``rendezvous.reform`` — one port-rebind race or
+    connect blip must not turn a survivable shrink into exit 82), and the
+    ``rendezvous.reform`` fault point makes reform failure drillable. A
+    generation mismatch in any reply is a hard error: a peer answering with
+    a different shrink generation missed (or double-counted) a membership
+    transition and MUST NOT silently join — the two sides would disagree on
+    the world size their checkpoints and consensus checks assume.
+
+    ``master_addr`` overrides DNS resolution of the survivor master
+    (loopback drills), exactly like the consensus exchange.
+    """
+    cluster = Cluster(
+        survivors, current_host, port=reform_port() if port is None else port
+    )
+    if master_addr is not None:
+        cluster.master_host = master_addr
+    message = {"host": current_host, "generation": int(generation)}
+    message.update(payload or {})
+
+    def _handshake():
+        fault_point(
+            "rendezvous.reform",
+            host=current_host,
+            generation=generation,
+            survivors=len(survivors),
+        )
+        return cluster.synchronize(message, timeout=timeout)
+
+    from ..utils.retry import retry_transient
+
+    membership = retry_transient(
+        _handshake,
+        site="rendezvous.reform",
+        retry_on=(OSError, exc.PlatformError),
+    )
+    generations = {int(m.get("generation", -1)) for m in membership}
+    if generations != {int(generation)}:
+        raise exc.PlatformError(
+            "cluster reform handshake mixed shrink generations {} (expected "
+            "{}): a survivor missed a membership transition; aborting reform "
+            "rather than training under disagreeing world sizes".format(
+                sorted(generations), generation
+            )
+        )
+    return cluster, membership
 
 
 def distributed_run(

@@ -42,7 +42,7 @@ import threading
 import time
 
 from ..parallel.distributed import frame_message
-from ..utils.envconfig import env_float, env_int
+from ..utils.envconfig import env_float, env_int, env_port
 from . import tracing
 from .emit import emit_metric
 from .registry import REGISTRY, percentile
@@ -759,6 +759,17 @@ _plane_lock = threading.Lock()
 _active_plane = None
 
 
+def stop_cluster_telemetry():
+    """Stop the active cluster plane (if any): membership-reform teardown —
+    the sender/aggregator carry the OLD world's ranks and must rebind over
+    the survivor list — and test cleanup. Safe to call when inert."""
+    global _active_plane
+    with _plane_lock:
+        plane, _active_plane = _active_plane, None
+    if plane is not None:
+        plane.stop()
+
+
 def start_cluster_telemetry(hosts, current_host, registry=None):
     """Bring up this host's share of the cluster plane; the single wiring
     entrypoint called from the distributed-training path.
@@ -785,27 +796,24 @@ def start_cluster_telemetry(hosts, current_host, registry=None):
     register_runtime_gauges()
     ordered = sorted(hosts)
     rank = ordered.index(current_host)
-    port = env_int(HEARTBEAT_PORT_ENV, DEFAULT_HEARTBEAT_PORT, minimum=1, maximum=65535)
+    port = env_port(HEARTBEAT_PORT_ENV, DEFAULT_HEARTBEAT_PORT)
     aggregator = None
     metrics_server = None
     if rank == 0:
         on_stale = None
+        from ..training.elastic import is_active as elastic_active
         from ..training.watchdog import abort_on_stale_enabled
 
-        if abort_on_stale_enabled():
-            # promote detection into action: one abort broadcast + local
-            # abort per stale episode. Lazy import inside the hook keeps
-            # the telemetry package import-cycle-free.
+        if abort_on_stale_enabled() or elastic_active():
+            # promote detection into action: the supervision layer decides
+            # between a shrink-to-continue (SM_ELASTIC) and the legacy
+            # coordinated abort, once per stale episode. Lazy import inside
+            # the hook keeps the telemetry package import-cycle-free.
             def on_stale(stale_rank, stale_host, age_s):
-                from ..training.watchdog import coordinate_abort
+                from ..training.watchdog import handle_stale_host
 
-                coordinate_abort(
-                    ordered,
-                    current_host,
-                    "stale_host",
-                    stale_rank=stale_rank,
-                    stale_host=stale_host,
-                    age_s=round(age_s, 1),
+                handle_stale_host(
+                    ordered, current_host, stale_rank, stale_host, age_s
                 )
 
         try:

@@ -49,7 +49,7 @@ _BACKTICK = re.compile(r"`([^`\s][^`]*)`")
 _TABLE_CELL = re.compile(r"^\|\s*`([^`]+)`")
 _ENV_READERS = {"os.getenv", "os.environ.get", "environ.get", "getenv",
                 "os.environ.setdefault", "environ.setdefault"}
-_ENVCONFIG_HELPERS = {"env_int", "env_float", "env_bool"}
+_ENVCONFIG_HELPERS = {"env_int", "env_float", "env_bool", "env_port"}
 _REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 
 

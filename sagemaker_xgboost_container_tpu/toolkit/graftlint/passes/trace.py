@@ -58,7 +58,7 @@ from ..astutil import (
 _JIT_LEAVES = {"jit", "pjit"}
 _WRAPPER_LEAVES = {"partial", "jit", "pjit", "shard_map", "vmap", "checkpoint", "remat"}
 _ENV_CALLS = {"os.getenv", "os.environ.get", "environ.get", "getenv"}
-_ENVCONFIG_HELPERS = {"env_int", "env_float", "env_bool"}
+_ENVCONFIG_HELPERS = {"env_int", "env_float", "env_bool", "env_port"}
 _CACHE_DECORATORS = {"lru_cache", "cache", "functools.lru_cache", "functools.cache"}
 _NUMPY_SYNC_LEAVES = {"asarray", "array", "ascontiguousarray"}
 

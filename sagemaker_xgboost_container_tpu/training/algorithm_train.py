@@ -160,12 +160,28 @@ def sagemaker_train(
             include_in_training = False
         def _pre_exec(participating_hosts, current_host):
             # order matters: jax.distributed first (it must precede any JAX
-            # computation), then the abort listener (it must be up before
-            # rank 0's aggregator can ever decide to broadcast), then the
-            # heartbeat plane over the RE-FORMED cluster — ranks must match
-            # the participating host list, not the original SM_HOSTS
-            # (hosts without data already exited)
+            # computation), then the elastic membership registration (its
+            # resolved SM_ELASTIC snapshot gates the abort listener), then
+            # the abort listener (it must be up before rank 0's aggregator
+            # can ever decide to broadcast), then the heartbeat plane over
+            # the RE-FORMED cluster — ranks must match the participating
+            # host list, not the original SM_HOSTS (hosts without data
+            # already exited)
             maybe_init_jax_distributed(participating_hosts, current_host)
+            from . import elastic
+
+            if combine_train_val:
+                # k-fold CV trains many per-fold callback stacks with no
+                # single resume point to reform around — shrink-to-continue
+                # is out of scope there, so leave the plane unregistered
+                # (inert callback, legacy stale-host abort applies)
+                if elastic.resolve_elastic_config().enabled:
+                    logger.warning(
+                        "SM_ELASTIC is not supported for k-fold CV jobs; a "
+                        "dead host takes the legacy coordinated abort"
+                    )
+            else:
+                elastic.register_cluster(participating_hosts, current_host)
             from .watchdog import start_abort_plane
 
             start_abort_plane(participating_hosts, current_host)
@@ -329,12 +345,47 @@ def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
         )
 
 
+def _reinit_jax_distributed(sm_hosts, sm_current_host):
+    """Re-init the multi-host XLA runtime at the shrunken world size.
+
+    The elastic reform hook: tear down the old coordination client (whose
+    membership still includes the dead host) and bring the runtime back up
+    over the survivor list. On CPU-auto paths (drills, single-accelerator
+    hosts) both halves are no-ops, exactly like startup.
+    """
+    import jax
+
+    try:
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            jax.distributed.shutdown()
+    except Exception as e:
+        # a coordination client wedged on the dead host may refuse a clean
+        # shutdown; re-init decides whether that is fatal
+        logger.warning("jax.distributed shutdown before re-init failed: %s", e)
+    return maybe_init_jax_distributed(sm_hosts, sm_current_host)
+
+
 def train_job(
     train_cfg, train_dmatrix, val_dmatrix, train_val_dmatrix, model_dir, checkpoint_dir, is_master
 ):
-    """Run boosting (or repeated k-fold CV) on this node; save master-only."""
+    """Run boosting (or repeated k-fold CV) on this node; save master-only.
+
+    With the elastic plane armed (``SM_ELASTIC``), the single-model branch
+    runs under ``elastic.supervised_train``: a membership reform unwinds the
+    boosting loop at a round boundary, survivors re-rendezvous, and this
+    function's ``train_once`` closure rebuilds everything per generation —
+    fresh callbacks (which re-read the last digest-verified checkpoint and
+    validate the recorded world-size transition), a fresh mesh over the
+    re-initialized runtime, and a rebuilt booster session under the SAME
+    hist-knobs snapshot. ``is_master`` survives a shrink unchanged: the
+    master is the sorted-first participant, and only the master's own
+    aggregator can propose a shrink — a dead master is not survivable (the
+    legacy jax heartbeat timeout applies) and is documented as such.
+    """
     train_cfg = dict(train_cfg)
-    mesh = _training_mesh(train_cfg.pop("_num_devices", None))
+    num_devices_cap = train_cfg.pop("_num_devices", None)
+    mesh = _training_mesh(num_devices_cap)
     # r2: ranking objectives shard rows by group and survival:cox gathers
     # global risk sets inside the jitted round, so every objective trains on
     # a data-parallel mesh
@@ -392,29 +443,64 @@ def train_job(
         from .profiling import xla_trace
 
         if kfold is None:
-            xgb_model, iteration, callbacks = get_callbacks(
-                model_dir=model_dir,
-                checkpoint_dir=checkpoint_dir,
-                early_stopping_data_name=early_stopping_data_name,
-                early_stopping_metric=early_stopping_metric,
-                early_stopping_rounds=early_stopping_rounds,
-                save_model_on_termination=save_model_on_termination,
-                is_master=is_master,
-                num_round=num_round,
-                num_rows=train_dmatrix.num_row,
-                train_cfg=train_cfg,
-            )
-            with xla_trace(), span("train", emit=True):
-                bst = booster.train(
-                    train_cfg,
-                    train_dmatrix,
-                    num_boost_round=num_round - iteration,
-                    evals=watchlist,
-                    feval=configured_feval,
-                    callbacks=callbacks,
-                    xgb_model=xgb_model,
-                    mesh=mesh,
+            from ..ops.histogram import resolve_hist_knobs
+            from . import elastic
+
+            # one knob snapshot for the whole job: every generation the
+            # reform loop rebuilds the session with, so a shrink can never
+            # pick up mid-job env drift
+            hist_knobs = resolve_hist_knobs()
+            mesh_box = {"mesh": mesh}
+
+            def _train_once():
+                xgb_model, iteration, callbacks = get_callbacks(
+                    model_dir=model_dir,
+                    checkpoint_dir=checkpoint_dir,
+                    early_stopping_data_name=early_stopping_data_name,
+                    early_stopping_metric=early_stopping_metric,
+                    early_stopping_rounds=early_stopping_rounds,
+                    save_model_on_termination=save_model_on_termination,
+                    is_master=is_master,
+                    num_round=num_round,
+                    num_rows=train_dmatrix.num_row,
+                    train_cfg=train_cfg,
                 )
+                try:
+                    with xla_trace(), span("train", emit=True):
+                        return booster.train(
+                            train_cfg,
+                            train_dmatrix,
+                            num_boost_round=num_round - iteration,
+                            evals=watchlist,
+                            feval=configured_feval,
+                            callbacks=callbacks,
+                            xgb_model=xgb_model,
+                            mesh=mesh_box["mesh"],
+                            hist_knobs=hist_knobs,
+                        )
+                except elastic.ReformRequested:
+                    # the abandoned generation's threads (watchdog monitor,
+                    # checkpoint deleter) must not outlive it — a stale
+                    # watchdog firing mid-reform would exit 79 a healthy
+                    # survivor
+                    elastic.drain_callbacks(callbacks)
+                    raise
+
+            def _on_reform(new_hosts, current_host):
+                # per-generation re-wiring: runtime first (as at startup),
+                # then the mesh over the new device set, then the control
+                # planes over the survivor list
+                _reinit_jax_distributed(new_hosts, current_host)
+                mesh_box["mesh"] = _training_mesh(num_devices_cap)
+                from .watchdog import start_abort_plane
+
+                start_abort_plane(new_hosts, current_host)
+                start_cluster_telemetry(new_hosts, current_host)
+                from ..telemetry import tracing
+
+                tracing.set_rank(sorted(new_hosts).index(current_host))
+
+            bst = elastic.supervised_train(_train_once, on_reform=_on_reform)
         else:
             num_cv_round = train_cfg.pop("_num_cv_round", 1)
             logger.info(
@@ -527,6 +613,7 @@ def train_job(
     os.makedirs(model_dir, exist_ok=True)
     if is_master:
         from ..utils import integrity
+        from . import elastic
 
         def _save_with_manifest(model, model_location):
             model.save_model(model_location)
@@ -534,10 +621,14 @@ def train_job(
                 # the manifest travels inside model.tar.gz: serving
                 # digest-verifies the artifact at load. Best-effort — a
                 # failed sidecar write must not fail a finished job (the
-                # model loads manifest-less, exactly like older runs)
+                # model loads manifest-less, exactly like older runs).
+                # A model that trained through elastic shrinks carries the
+                # full membership log — the provenance record for "this
+                # artifact lost host N's rows at epoch E".
                 integrity.write_manifest(
                     model_location,
                     fingerprint=integrity.config_fingerprint(train_cfg),
+                    membership_log=elastic.membership_log() or None,
                 )
             except OSError as e:
                 logger.warning(

@@ -167,10 +167,16 @@ def get_callbacks(
         integrity.config_fingerprint(train_cfg) if train_cfg is not None else None
     )
 
+    from . import elastic
+
     xgb_model, iteration = checkpointing.load_checkpoint(checkpoint_dir)
     if xgb_model is not None:
         if fingerprint is not None:
-            integrity.validate_resume(xgb_model, fingerprint)
+            # the live membership log downgrades a recorded world-size
+            # transition (elastic shrink) from config skew to a clean resume
+            integrity.validate_resume(
+                xgb_model, fingerprint, membership_log=elastic.membership_log()
+            )
         logger.info("Checkpoint loaded from %s", xgb_model)
         logger.info("Resuming from iteration %s", iteration)
 
@@ -198,6 +204,7 @@ def get_callbacks(
                     start_iteration=iteration,
                     num_round=num_round,
                     fingerprint=fingerprint,
+                    membership_provider=elastic.membership_log,
                 ),
                 "checkpoint",
             )
@@ -214,6 +221,15 @@ def get_callbacks(
             )
         )
         add_sigterm_handler(model_dir, is_master)
+
+    # elastic membership (SM_ELASTIC): the shrink-to-continue drain point.
+    # AFTER the checkpoint/intermediate savers — the round that just
+    # finished (and passed consensus, ordered above the saver) lands on
+    # disk before the loop unwinds for the reform — and BEFORE early
+    # stopping so a reform round can't double-count as stagnation.
+    elastic_cb = elastic.maybe_elastic_callback()
+    if elastic_cb is not None:
+        callbacks.append(_TimedCallback(elastic_cb, "elastic"))
 
     if early_stopping_data_name and early_stopping_metric and early_stopping_rounds:
         callbacks.append(

@@ -148,7 +148,9 @@ def load_checkpoint(checkpoint_dir):
     return None, 0
 
 
-def _atomic_save(model, directory, final_name, iteration=None, fingerprint=None):
+def _atomic_save(
+    model, directory, final_name, iteration=None, fingerprint=None, membership_log=None
+):
     """tempfile + rename, with bounded transient-IO retries. Each attempt
     uses a fresh temp file and cleans up its own debris on failure, so a
     retried save can't leak ``.sagemaker-ignore`` orphans.
@@ -222,6 +224,7 @@ def _atomic_save(model, directory, final_name, iteration=None, fingerprint=None)
         fingerprint=fingerprint,
         digest=digest_box["sha256"],
         size=digest_box["bytes"],
+        membership_log=membership_log,
     )
     _atomic_write_manifest(directory, final_name + MANIFEST_SUFFIX, manifest)
 
@@ -281,6 +284,7 @@ class SaveCheckpointCallBack:
         max_to_keep=5,
         num_round=None,
         fingerprint=None,
+        membership_provider=None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.max_to_keep = max_to_keep
@@ -289,6 +293,10 @@ class SaveCheckpointCallBack:
         # config fingerprint stamped into every manifest sidecar; the resume
         # validator (utils/integrity.validate_resume) compares it on restart
         self.fingerprint = fingerprint
+        # elastic membership: a zero-arg callable returning the current
+        # transition log — called per save (not captured once) so a shrink
+        # mid-generation lands in the very next sidecar
+        self.membership_provider = membership_provider
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.previous_checkpoints = {
             os.path.join(checkpoint_dir, f) for f in os.listdir(checkpoint_dir)
@@ -309,6 +317,9 @@ class SaveCheckpointCallBack:
             "{}.{}".format(CHECKPOINT_FILENAME, epoch),
             iteration=epoch,
             fingerprint=self.fingerprint,
+            membership_log=(
+                self.membership_provider() if self.membership_provider else None
+            ),
         )
         self.delete_queue.put(epoch - self.max_to_keep)
         if self.num_round is not None and epoch + 1 >= self.num_round:

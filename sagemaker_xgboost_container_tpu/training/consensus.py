@@ -37,7 +37,7 @@ import logging
 from ..constants import EXIT_CONSENSUS_DIVERGENCE
 from ..telemetry import REGISTRY
 from ..telemetry.emit import emit_metric
-from ..utils.envconfig import env_float, env_int
+from ..utils.envconfig import env_float, env_int, env_port
 from ..utils.faults import fault_point
 from ..utils.integrity import forest_digest
 
@@ -64,7 +64,7 @@ def consensus_every():
 
 
 def consensus_port():
-    return env_int(CONSENSUS_PORT_ENV, DEFAULT_CONSENSUS_PORT, minimum=1, maximum=65535)
+    return env_port(CONSENSUS_PORT_ENV, DEFAULT_CONSENSUS_PORT)
 
 
 def consensus_timeout_s():
@@ -100,8 +100,11 @@ def cluster_exchange(hosts, current_host, port=None, timeout=None, master_addr=N
         cluster = Cluster(hosts, current_host, port=consensus_port() if port is None else port)
         if master_addr is not None:
             cluster.master_host = master_addr
+        # world rides along so a rank whose membership drifted (missed an
+        # elastic shrink, resumed at a stale world size) is caught as a
+        # membership pathology, not misread as tree divergence
         return cluster.synchronize(
-            {"digest": digest, "round": rnd},
+            {"digest": digest, "round": rnd, "world": len(hosts)},
             timeout=consensus_timeout_s() if timeout is None else timeout,
         )
 
@@ -192,6 +195,20 @@ class ConsensusGuard:
         # can be validated; injected exchanges (tests, the dryrun drill) may
         # return bare digest lists
         if replies and isinstance(replies[0], dict):
+            worlds = {int(r.get("world", self.world_size)) for r in replies}
+            if worlds != {self.world_size}:
+                # membership drift: a rank answering with a different world
+                # size missed (or hasn't finished) an elastic membership
+                # transition — its forest legitimately differs, so a digest
+                # verdict would abort a healthy cluster. Skip; the drifted
+                # rank either re-forms (its exchange keeps failing on the
+                # wrong host list) or the abort plane takes it down.
+                logger.warning(
+                    "consensus exchange at round %d mixed world sizes %s "
+                    "(this rank: %d); skipping this check as membership "
+                    "drift, not divergence", epoch, sorted(worlds), self.world_size,
+                )
+                return False
             rounds = {int(r.get("round", epoch)) for r in replies}
             if rounds != {epoch}:
                 # a check-index misalignment (one rank skipped a timed-out

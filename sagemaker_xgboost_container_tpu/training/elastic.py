@@ -1,0 +1,610 @@
+"""Elastic shrink-to-continue: survivor re-rendezvous and resharded resume.
+
+The supervision stack (PR 3/5/7) turned a dead host from a silent deadlock
+into a *clean, attributable* job failure: stale-heartbeat detection, a
+coordinated abort broadcast, exit 80, restart at the original world size.
+But a host that is truly gone never comes back — the restarted job waits at
+the rendezvous for a peer that no longer exists, and a multi-hour
+north-star run dies with it. This module composes the existing ingredients
+into actual fault *tolerance*:
+
+1. **Detection -> shrink decision.** When rank 0's heartbeat aggregator
+   declares a host stale (``telemetry/cluster.py``), the decision hook
+   (``training/watchdog.handle_stale_host``) consults this module: with
+   ``SM_ELASTIC=1`` and the floors satisfied (``SM_ELASTIC_MIN_HOSTS``
+   survivors remaining, fewer than ``SM_ELASTIC_MAX_SHRINKS`` shrinks so
+   far) rank 0 *proposes a survivor set* instead of plain exit 80. The
+   legacy coordinated abort is untouched when the gate is closed.
+2. **Shrink fan-out.** The proposal rides the existing abort channel — one
+   frame per survivor carrying ``verb: "shrink"``, the survivor host list,
+   and a monotonically increasing *generation* — so no new listener socket
+   or port is introduced and the abort plane's idempotence (duplicate-frame
+   suppression, first-wins dispatch) covers racing detections for free.
+3. **Re-rendezvous.** Every survivor finishes its in-flight round (the
+   :class:`ElasticMembershipCallback` raises :class:`ReformRequested` at
+   the round boundary — that IS the drain), tears down the heartbeat/abort
+   planes, and re-runs the bounded rendezvous handshake over the survivor
+   list (``parallel/distributed.reform_cluster``: retried, deadline-bounded,
+   fault point ``rendezvous.reform``). A reform that cannot complete aborts
+   every survivor with the distinct ``EXIT_REFORM_FAILED`` (82) and a
+   flight-recorder dump — restart then resumes at the *old* membership.
+4. **Resharded resume.** The caller's ``train_once`` (train_job) reloads
+   the last digest-verified checkpoint and rebuilds the booster session on
+   the new, smaller mesh — rows rebin/repartition over the shrunken data
+   axis as a consequence of the rebuilt session, under the SAME
+   ``hist_knobs`` snapshot as the original session (no mid-job env drift).
+   ``utils/integrity.validate_resume`` accepts the ``world_size``
+   fingerprint drift because this module *records the transition*: an
+   append-only ``membership_log`` (old/new size, epoch, reason, surviving
+   ranks, generation) stamped into every subsequent checkpoint manifest,
+   which later resumes — and operators — validate against.
+
+Everything is env-gated and inert by default: ``SM_ELASTIC`` unset means no
+callback in the stack, no state, and byte-identical legacy behavior (the
+same kill still produces the coordinated exit 80).
+"""
+
+import logging
+import threading
+
+from ..constants import EXIT_CLUSTER_ABORT, EXIT_REFORM_FAILED
+from ..telemetry import REGISTRY
+from ..telemetry.emit import emit_metric
+from ..utils.envconfig import env_bool, env_float, env_int
+
+logger = logging.getLogger(__name__)
+
+ELASTIC_ENV = "SM_ELASTIC"
+ELASTIC_MIN_HOSTS_ENV = "SM_ELASTIC_MIN_HOSTS"
+ELASTIC_MAX_SHRINKS_ENV = "SM_ELASTIC_MAX_SHRINKS"
+REFORM_TIMEOUT_ENV = "SM_REFORM_TIMEOUT_S"
+REFORM_DRAIN_TIMEOUT_ENV = "SM_REFORM_DRAIN_TIMEOUT_S"
+
+
+class ElasticConfig:
+    """Snapshot of the elastic knobs, resolved ONCE at session build
+    (``register_cluster``) so no decision path re-reads env mid-job — the
+    same trace-env-read discipline as the histogram knob snapshot."""
+
+    def __init__(
+        self, enabled, min_hosts, max_shrinks, reform_timeout_s, drain_timeout_s
+    ):
+        self.enabled = bool(enabled)
+        self.min_hosts = int(min_hosts)
+        self.max_shrinks = int(max_shrinks)
+        self.reform_timeout_s = float(reform_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+
+    def __repr__(self):
+        return (
+            "ElasticConfig(enabled={}, min_hosts={}, max_shrinks={}, "
+            "reform_timeout_s={}, drain_timeout_s={})".format(
+                self.enabled,
+                self.min_hosts,
+                self.max_shrinks,
+                self.reform_timeout_s,
+                self.drain_timeout_s,
+            )
+        )
+
+
+def resolve_elastic_config():
+    """Read the elastic knobs (clamped, warn-once via envconfig)."""
+    return ElasticConfig(
+        enabled=env_bool(ELASTIC_ENV, False),
+        min_hosts=env_int(ELASTIC_MIN_HOSTS_ENV, 1, minimum=1),
+        max_shrinks=env_int(ELASTIC_MAX_SHRINKS_ENV, 2, minimum=0, maximum=64),
+        reform_timeout_s=env_float(REFORM_TIMEOUT_ENV, 60.0, minimum=1.0, maximum=3600.0),
+        drain_timeout_s=env_float(
+            REFORM_DRAIN_TIMEOUT_ENV, 300.0, minimum=1.0, maximum=7200.0
+        ),
+    )
+
+
+class ReformRequested(Exception):
+    """Raised at a round boundary by :class:`ElasticMembershipCallback` to
+    unwind the training loop for a membership reform. Carries everything
+    ``perform_reform`` needs; never escapes ``supervised_train``."""
+
+    def __init__(self, survivors, reason, generation, epoch=None):
+        self.survivors = sorted(survivors)
+        self.reason = str(reason)
+        self.generation = int(generation)
+        self.epoch = epoch
+        super(ReformRequested, self).__init__(
+            "membership reform requested (generation {}, reason {}): "
+            "survivors {}".format(generation, reason, self.survivors)
+        )
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.hosts = None
+        self.current_host = None
+        self.config = None
+        self.peer_addrs = None  # {host: (addr, port)} — loopback drills only
+        self.generation = 0
+        self.shrinks = 0
+        self.membership_log = []
+        self.pending = None
+        self.draining = False  # a reform is past the drain (being executed)
+        self.drain_timer = None
+
+
+_state = _State()
+
+
+def register_cluster(hosts, current_host, config=None, peer_addrs=None):
+    """Record membership + resolve the elastic config snapshot.
+
+    Called once at session build from ``algorithm_train._pre_exec`` on every
+    participant (and by drills with explicit loopback ``peer_addrs``).
+    """
+    with _state.lock:
+        _state.hosts = sorted(hosts)
+        _state.current_host = current_host
+        _state.config = config if config is not None else resolve_elastic_config()
+        _state.peer_addrs = dict(peer_addrs) if peer_addrs else None
+        world = len(_state.hosts)
+        cfg = _state.config
+    REGISTRY.gauge(
+        "cluster_world_size", "Hosts in the current (possibly shrunken) membership"
+    ).set(world)
+    if cfg.enabled:
+        logger.info(
+            "elastic membership armed: world size %d, floor %d host(s), at "
+            "most %d shrink(s), reform deadline %.0fs",
+            world, cfg.min_hosts, cfg.max_shrinks, cfg.reform_timeout_s,
+        )
+    return cfg
+
+
+def _reset_for_tests():
+    global _state
+    with _state.lock:
+        timer = _state.drain_timer
+    if timer is not None:
+        timer.cancel()
+    _state = _State()
+
+
+def is_active():
+    with _state.lock:
+        return _state.hosts is not None and _state.config is not None and _state.config.enabled
+
+
+def current_hosts():
+    with _state.lock:
+        return list(_state.hosts) if _state.hosts else None
+
+
+def world_size():
+    with _state.lock:
+        return len(_state.hosts) if _state.hosts else 0
+
+
+def generation():
+    with _state.lock:
+        return _state.generation
+
+
+def membership_log():
+    """Append-only transition log (copies): one entry per completed shrink,
+    stamped into every subsequent checkpoint manifest."""
+    with _state.lock:
+        return [dict(t) for t in _state.membership_log]
+
+
+def peer_addrs():
+    """{host: (addr, port)} override map for loopback drills, or None —
+    production resolves hostnames and the default abort port."""
+    with _state.lock:
+        return dict(_state.peer_addrs) if _state.peer_addrs else None
+
+
+# ----------------------------------------------------------- shrink decision
+def propose_survivors(stale_host):
+    """Rank 0's shrink proposal for a stale host, or None with the reason
+    the legacy exit-80 path applies. Reads only the resolved snapshot."""
+    with _state.lock:
+        hosts = list(_state.hosts or [])
+        cfg = _state.config
+        shrinks = _state.shrinks
+    if cfg is None or not cfg.enabled:
+        return None
+    if stale_host not in hosts:
+        logger.info(
+            "elastic: stale host %s is not in the current membership %s "
+            "(already shrunk away); ignoring", stale_host, hosts,
+        )
+        return None
+    survivors = [h for h in hosts if h != stale_host]
+    if len(survivors) < cfg.min_hosts:
+        logger.warning(
+            "elastic: cannot shrink below the %d-host floor (%s survivors "
+            "would remain); falling back to the coordinated abort",
+            cfg.min_hosts, len(survivors),
+        )
+        return None
+    if shrinks >= cfg.max_shrinks:
+        logger.warning(
+            "elastic: shrink budget exhausted (%d of %d); falling back to "
+            "the coordinated abort", shrinks, cfg.max_shrinks,
+        )
+        return None
+    return survivors
+
+
+def coordinate_shrink(survivors, reason, epoch=None, **fields):
+    """Rank 0: fan the shrink proposal out over the abort channel, then arm
+    the local reform. Returns the pending request.
+
+    The frame goes to EVERY current member except this host — survivors
+    re-form, and an excluded host that turns out to be alive (false-stale:
+    transient partition, GC pause) learns it was declared dead and exits 80
+    through ``on_shrink_frame``'s excluded branch instead of zombie-training
+    at the old membership. The frame carries ``verb: "shrink"``, the
+    survivor list, and the next generation; the abort listener's
+    duplicate-frame suppression makes racing detections deliver exactly one
+    reform per generation.
+    """
+    from ..parallel.distributed import broadcast_abort
+
+    with _state.lock:
+        current_host = _state.current_host
+        hosts = list(_state.hosts or [])
+        gen = _state.generation + 1
+        peer_addrs = dict(_state.peer_addrs or {}) or None
+    extra = {
+        "verb": "shrink",
+        "survivors": sorted(survivors),
+        "generation": gen,
+    }
+    peers = [h for h in hosts if h != current_host]
+    delivered = broadcast_abort(
+        peers, reason, source=current_host, extra=extra, peer_addrs=peer_addrs
+    )
+    logger.warning(
+        "elastic shrink (generation %d, reason %s): notified %d/%d "
+        "members; dropping to world size %d",
+        gen, reason, delivered, len(peers), len(survivors),
+    )
+    request_reform(survivors, reason, generation=gen, epoch=epoch, **fields)
+    return pending_reform()
+
+
+def on_shrink_frame(msg):
+    """Survivor side of the fan-out (wired from ``watchdog._on_abort_frame``
+    for frames carrying the shrink verb)."""
+    survivors = msg.get("survivors")
+    if not isinstance(survivors, list) or not survivors:
+        logger.warning("elastic: ignoring shrink frame without survivors: %r", msg)
+        return
+    with _state.lock:
+        current_host = _state.current_host
+    if current_host is not None and current_host not in survivors:
+        # the proposer declared US dead (asymmetric partition / clock skew):
+        # there is no membership to continue in — exit through the legacy
+        # coordinated-abort path so the platform restarts this host
+        from . import watchdog
+
+        logger.error(
+            "elastic: shrink frame excludes this host (%s not in %s); "
+            "aborting with the cluster exit code", current_host, survivors,
+        )
+        watchdog.request_abort(
+            "shrunk_away", EXIT_CLUSTER_ABORT, source=msg.get("source")
+        )
+        return
+    request_reform(
+        survivors,
+        msg.get("reason", "shrink"),
+        generation=msg.get("generation"),
+    )
+
+
+def request_reform(survivors, reason, generation=None, epoch=None, **fields):
+    """Arm a pending reform; idempotent per generation (a duplicate or
+    stale-generation request is a logged no-op). Thread-safe — callers are
+    the aggregator thread (rank 0) and the abort-listener thread (peers);
+    the training thread consumes via :func:`pending_reform`."""
+    with _state.lock:
+        if _state.hosts is None:
+            logger.warning(
+                "elastic: reform requested but no cluster is registered; ignoring"
+            )
+            return False
+        gen = int(generation) if generation is not None else _state.generation + 1
+        if gen <= _state.generation:
+            logger.info(
+                "elastic: ignoring reform request for past generation %d "
+                "(current %d)", gen, _state.generation,
+            )
+            return False
+        if _state.pending is not None and _state.pending["generation"] >= gen:
+            logger.info(
+                "elastic: reform already pending (generation %d); ignoring "
+                "duplicate request", _state.pending["generation"],
+            )
+            return False
+        _state.pending = {
+            "survivors": sorted(survivors),
+            "reason": str(reason),
+            "generation": gen,
+            "epoch": epoch,
+        }
+        _state.pending.update(fields)
+        _state.draining = False
+        drain_timeout = (
+            _state.config.drain_timeout_s if _state.config is not None else 300.0
+        )
+        # the drain-deadline demotion: the drain point is the next round
+        # boundary, but a survivor wedged INSIDE a jitted collective (the
+        # dead host was mid-psum with us) never reaches one. Without this
+        # timer the elastic gate would turn the legacy fail-fast exit 80
+        # into an indefinite hang — strictly worse than SM_ELASTIC unset.
+        # Every rank arms its own timer when its reform arms; consumption
+        # (perform_reform starting) disarms it.
+        timer = threading.Timer(drain_timeout, _drain_deadline_expired, args=(gen,))
+        timer.daemon = True
+        _state.drain_timer = timer
+    timer.start()
+    logger.warning(
+        "elastic: reform armed (generation %d, reason %s); the training "
+        "loop will drain the current round and re-rendezvous as %s "
+        "(coordinated abort if the drain takes more than %.0fs — a wedged "
+        "collective cannot drain)",
+        gen, reason, sorted(survivors), drain_timeout,
+    )
+    return True
+
+
+def _drain_deadline_expired(generation_armed):
+    """Timer body: the reform armed at ``generation_armed`` was never
+    consumed — this survivor is stuck inside a collective the dead host
+    poisoned and will never reach a round boundary. Demote the shrink to
+    the legacy coordinated-abort exit so the job fails fast and restarts
+    at the old membership, exactly as with ``SM_ELASTIC`` unset."""
+    with _state.lock:
+        stale = (
+            _state.pending is not None
+            and _state.pending["generation"] == generation_armed
+            and not _state.draining
+        )
+    if not stale:
+        return
+    from . import watchdog
+
+    logger.error(
+        "elastic: reform (generation %d) was never drained within the "
+        "deadline — this rank is wedged in a collective; demoting the "
+        "shrink to the coordinated abort", generation_armed,
+    )
+    watchdog.request_abort(
+        "reform_drain_timeout", EXIT_CLUSTER_ABORT, generation=generation_armed
+    )
+
+
+def pending_reform():
+    with _state.lock:
+        return dict(_state.pending) if _state.pending is not None else None
+
+
+# ------------------------------------------------------------ training hooks
+class ElasticMembershipCallback:
+    """Booster-protocol callback: the drain point of the shrink protocol.
+
+    Sits after the checkpoint saver so the just-finished (consensus-passed)
+    round lands on disk before the loop unwinds; raising at the round
+    boundary IS the in-flight-work drain."""
+
+    def after_iteration(self, model, epoch, evals_log):
+        req = pending_reform()
+        if req is not None:
+            raise ReformRequested(
+                req["survivors"], req["reason"], req["generation"], epoch=epoch
+            )
+        return False
+
+
+def maybe_elastic_callback():
+    """-> an ElasticMembershipCallback when the plane is armed, else None."""
+    return ElasticMembershipCallback() if is_active() else None
+
+
+def drain_callbacks(callbacks):
+    """Best-effort teardown of a callback stack abandoned by a reform:
+    stop every thread-owning callback (round watchdog monitor, checkpoint
+    deleter) so the old generation can't fire a stale exit-79 or hold the
+    checkpoint dir while the new generation rebuilds."""
+    for cb in callbacks or []:
+        inner = getattr(cb, "inner", cb)
+        stop = getattr(inner, "stop", None)
+        if callable(stop):
+            try:
+                stop()
+            except Exception:
+                logger.exception("elastic drain: error stopping %r", inner)
+
+
+# ------------------------------------------------------------------- reform
+def perform_reform(req, on_reform=None, master_addr=None, port=None):
+    """Execute one membership reform on the training thread.
+
+    Drain (tear down the heartbeat/abort planes, settle checkpoints), then
+    the retried survivor re-rendezvous, then commit: membership + generation
+    + the append-only transition record, telemetry, and consensus
+    re-registration. ``on_reform(new_hosts, current_host)`` is the caller's
+    re-wiring hook (jax.distributed re-init, plane restarts). Any failure
+    aborts this survivor with ``EXIT_REFORM_FAILED`` (82) — the abort path
+    dumps the flight recorder, and the re-raise covers test harnesses that
+    stub the hard exit.
+    """
+    from ..telemetry.tracing import trace_span
+
+    with _state.lock:
+        current_host = _state.current_host
+        cfg = _state.config
+        old_hosts = list(_state.hosts or [])
+        # the drain happened: this rank reached a round boundary and is now
+        # executing the reform — disarm the wedged-collective demotion
+        _state.draining = True
+        timer, _state.drain_timer = _state.drain_timer, None
+    if timer is not None:
+        timer.cancel()
+    reason = req.reason
+    try:
+        with trace_span(
+            "cluster.reform",
+            attributes={
+                "generation": req.generation,
+                "reason": reason,
+                "old_world_size": len(old_hosts),
+                "new_world_size": len(req.survivors),
+            },
+        ):
+            with trace_span("reform.drain"):
+                _teardown_planes()
+            with trace_span("reform.rendezvous"):
+                from ..parallel.distributed import reform_cluster
+
+                cluster, membership = reform_cluster(
+                    req.survivors,
+                    current_host,
+                    req.generation,
+                    timeout=cfg.reform_timeout_s if cfg else 60.0,
+                    master_addr=master_addr,
+                    port=port,
+                )
+            transition = _commit_transition(req, old_hosts)
+            emit_metric("training.membership", **transition)
+            REGISTRY.counter(
+                "elastic_shrink_total",
+                "Completed shrink-to-continue membership transitions",
+                {"reason": reason},
+            ).inc()
+            REGISTRY.gauge(
+                "cluster_world_size",
+                "Hosts in the current (possibly shrunken) membership",
+            ).set(len(req.survivors))
+            from . import consensus
+
+            consensus.register_cluster(req.survivors, current_host)
+            if on_reform is not None:
+                on_reform(list(req.survivors), current_host)
+        logger.warning(
+            "elastic: reform complete — training continues at world size %d "
+            "(generation %d)", len(req.survivors), req.generation,
+        )
+        return cluster
+    except Exception as e:
+        logger.exception(
+            "elastic: reform FAILED at generation %d (%s); aborting this "
+            "survivor with exit %d — restart resumes at the old membership",
+            req.generation, e, EXIT_REFORM_FAILED,
+        )
+        from . import watchdog
+
+        watchdog.request_abort(
+            "reform_failed",
+            EXIT_REFORM_FAILED,
+            generation=req.generation,
+            survivors=list(req.survivors),
+            error=str(e),
+        )
+        raise
+
+
+def _teardown_planes():
+    """Stop the per-generation control planes before re-rendezvous: the
+    heartbeat sender/aggregator (its membership is the OLD world), the abort
+    listener (rebound by the caller's re-wiring hook), and the checkpoint
+    deleters (the resumed generation builds fresh savers)."""
+    from ..telemetry.cluster import stop_cluster_telemetry
+
+    stop_cluster_telemetry()
+    from . import watchdog
+
+    watchdog.stop_abort_plane()
+    from . import checkpointing
+
+    checkpointing.flush_checkpoints()
+
+
+def _commit_transition(req, old_hosts):
+    """Advance the membership state and append the transition record."""
+    surviving_ranks = [
+        old_hosts.index(h) for h in req.survivors if h in old_hosts
+    ]
+    with _state.lock:
+        transition = {
+            "event": "shrink",
+            "generation": req.generation,
+            "old_world_size": len(old_hosts),
+            "new_world_size": len(req.survivors),
+            "epoch": req.epoch,
+            "reason": req.reason,
+            "surviving_ranks": surviving_ranks,
+            "hosts": list(req.survivors),
+        }
+        _state.membership_log.append(transition)
+        _state.hosts = list(req.survivors)
+        _state.generation = req.generation
+        _state.shrinks += 1
+        _state.pending = None
+        _state.draining = False
+    return dict(transition)
+
+
+def _disarm_pending(why):
+    """Cancel an armed-but-unconsumed reform (drain timer included).
+
+    The normal-completion path: a shrink verdict that lands during or after
+    the FINAL round has no remaining rounds to reform for — without this,
+    the drain-deadline timer would exit-80 a successfully finished job in
+    the middle of its model save. Returns the disarmed request, or None.
+    """
+    with _state.lock:
+        pending, _state.pending = _state.pending, None
+        timer, _state.drain_timer = _state.drain_timer, None
+        _state.draining = False
+    if timer is not None:
+        timer.cancel()
+    if pending is not None:
+        logger.warning(
+            "elastic: pending reform (generation %d) disarmed — %s",
+            pending["generation"], why,
+        )
+    return pending
+
+
+def supervised_train(train_once, on_reform=None, master_addr=None, reform_port=None):
+    """Run ``train_once()`` under the elastic reform loop.
+
+    ``train_once`` builds its callbacks (so each generation gets a fresh
+    stack, re-reads the checkpoint, and rebuilds the booster session on the
+    new mesh) and returns the trained model. On :class:`ReformRequested` the
+    reform executes and the loop re-enters; with the plane inert this is a
+    zero-cost passthrough. The loop is bounded by ``SM_ELASTIC_MAX_SHRINKS``
+    via the shrink-decision gate, not here. A reform still pending when
+    training returns normally (the shrink verdict raced the last round) is
+    disarmed — there are no rounds left to reform for, and its drain timer
+    must not fire into the post-training saves.
+    """
+    while True:
+        try:
+            result = train_once()
+        except ReformRequested as req:
+            logger.warning(
+                "elastic: training unwound for reform at epoch %s "
+                "(generation %d, reason %s)", req.epoch, req.generation, req.reason,
+            )
+            perform_reform(
+                req, on_reform=on_reform, master_addr=master_addr, port=reform_port
+            )
+        else:
+            _disarm_pending(
+                "training completed before the drain point; no rounds remain"
+            )
+            return result

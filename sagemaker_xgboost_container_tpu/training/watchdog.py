@@ -219,6 +219,14 @@ def _frame_exit_code(msg):
 
 
 def _on_abort_frame(msg):
+    if msg.get("verb") == "shrink":
+        # elastic membership: the frame proposes a survivor set instead of
+        # demanding an exit — hand it to the shrink plane (which falls back
+        # to a plain abort when this host was itself declared dead)
+        from . import elastic
+
+        elastic.on_shrink_frame(msg)
+        return
     request_abort(
         str(msg.get("reason", "cluster_abort")),
         _frame_exit_code(msg),
@@ -226,22 +234,33 @@ def _on_abort_frame(msg):
     )
 
 
-def start_abort_plane(hosts, current_host):
-    """Start this host's abort listener (gated on ``SM_ABORT_ON_STALE``).
+_listener_lock = threading.Lock()
+_active_listener = None
 
+
+def start_abort_plane(hosts, current_host, port=None):
+    """Start this host's abort listener.
+
+    Gated on ``SM_ABORT_ON_STALE`` — or on an armed elastic plane
+    (``SM_ELASTIC``), whose shrink frames arrive over the same channel.
     Every participant — including rank 0, for one uniform code path — gets
     a listener; rank 0 additionally wires the heartbeat aggregator's
-    stale-host detection to :func:`coordinate_abort` (telemetry/cluster.py).
-    Returns the listener or None when the plane is disabled.
+    stale-host detection to :func:`handle_stale_host` (telemetry/cluster.py).
+    Returns the listener or None when the plane is disabled. The active
+    listener is tracked so a membership reform can tear it down and rebind
+    (:func:`stop_abort_plane`).
     """
-    if not abort_on_stale_enabled():
+    from . import elastic
+
+    if not (abort_on_stale_enabled() or elastic.is_active()):
         return None
     if len(hosts) <= 1:
         return None
     from ..parallel.distributed import AbortListener
 
+    stop_abort_plane()
     try:
-        listener = AbortListener(handler=_on_abort_frame).start()
+        listener = AbortListener(handler=_on_abort_frame, port=port).start()
     except OSError as e:
         logger.warning(
             "abort listener could not bind (%s); this host will rely on the "
@@ -251,21 +270,89 @@ def start_abort_plane(hosts, current_host):
     logger.info(
         "abort listener up on port %d (host %s)", listener.port, current_host
     )
+    global _active_listener
+    with _listener_lock:
+        _active_listener = listener
     return listener
 
 
-def coordinate_abort(hosts, current_host, reason, exit_code=EXIT_CLUSTER_ABORT, **fields):
+def stop_abort_plane():
+    """Stop the tracked abort listener (reform teardown / test cleanup)."""
+    global _active_listener
+    with _listener_lock:
+        listener, _active_listener = _active_listener, None
+    if listener is not None:
+        try:
+            listener.stop()
+        except Exception:
+            logger.exception("error stopping abort listener")
+
+
+def handle_stale_host(hosts, current_host, stale_rank, stale_host, age_s):
+    """Rank 0's detection -> action decision for a stale host.
+
+    With the elastic plane armed and its floors satisfied
+    (``SM_ELASTIC_MIN_HOSTS`` survivors, shrink budget left), propose a
+    survivor set and shrink-to-continue; otherwise the legacy coordinated
+    abort (exit 80) — byte-identical behavior when ``SM_ELASTIC`` is unset.
+
+    One membership transition at a time: while a reform is already in
+    flight, further stale verdicts are DEFERRED, not folded in — a second
+    proposal before the first commits would reuse the same generation with
+    a survivor set still containing the first dead host, dooming the
+    rendezvous. The post-reform aggregator starts fresh over the survivors
+    and re-detects a host that is still dead, triggering the next
+    generation's shrink (or the legacy abort, if the floors say so).
+    """
+    from . import elastic
+
+    if elastic.is_active() and elastic.pending_reform() is not None:
+        logger.warning(
+            "stale host %s (rank %d) detected while a membership reform is "
+            "in flight; deferring — the re-formed cluster's aggregator "
+            "re-detects it and decides at the next generation",
+            stale_host, stale_rank,
+        )
+        return
+    survivors = elastic.propose_survivors(stale_host)
+    if survivors is not None:
+        elastic.coordinate_shrink(
+            survivors,
+            "stale_host",
+            stale_rank=stale_rank,
+            stale_host=stale_host,
+            age_s=round(age_s, 1),
+        )
+        return
+    coordinate_abort(
+        hosts,
+        current_host,
+        "stale_host",
+        peer_addrs=elastic.peer_addrs(),
+        stale_rank=stale_rank,
+        stale_host=stale_host,
+        age_s=round(age_s, 1),
+    )
+
+
+def coordinate_abort(
+    hosts, current_host, reason, exit_code=EXIT_CLUSTER_ABORT, peer_addrs=None, **fields
+):
     """Rank 0: broadcast one abort frame to every peer, then abort locally.
 
     ``exit_code`` rides inside the frame so every rank exits with the SAME
     distinguishing code (80 for stale-host aborts, 81 for consensus
     divergence) — the job log's exit code names the supervisor that fired
-    no matter which rank's log you're reading.
+    no matter which rank's log you're reading. ``peer_addrs`` optionally
+    maps hosts to (addr, port) pairs (loopback drills); production resolves
+    hostnames on the default abort port.
     """
     from ..parallel.distributed import broadcast_abort
 
     peers = [h for h in hosts if h != current_host]
-    delivered = broadcast_abort(peers, reason, source=current_host, exit_code=exit_code)
+    delivered = broadcast_abort(
+        peers, reason, source=current_host, exit_code=exit_code, peer_addrs=peer_addrs
+    )
     logger.error(
         "coordinated abort (%s): notified %d/%d peers", reason, delivered, len(peers)
     )

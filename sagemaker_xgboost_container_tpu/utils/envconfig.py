@@ -82,6 +82,17 @@ def env_float(name, default, minimum=None, maximum=None):
     return _clamp(name, raw, value, minimum, maximum)
 
 
+def env_port(name, default):
+    """TCP-port env knob: ``env_int`` clamped to the valid port range.
+
+    Every control-plane port (rendezvous, heartbeat, abort, consensus,
+    reform) shares this rule; a knob like ``SM_REFORM_PORT=0`` clamps to 1
+    with the usual warn-once rather than silently binding an ephemeral
+    port the peers could never guess.
+    """
+    return env_int(name, default, minimum=1, maximum=65535)
+
+
 def env_bool(name, default):
     """Boolean env knob: 1/true/yes/on and 0/false/no/off (case-insensitive);
     absent/empty -> ``default``; anything else -> ``default`` with a single

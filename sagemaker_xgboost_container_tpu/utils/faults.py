@@ -19,10 +19,14 @@ Spec grammar (entries separated by ``;`` or ``,``)::
     training.round_end:sigterm@3  3rd round delivers SIGTERM to this process
     sync.accept:drop              raises ConnectionError (socket drop)
     batcher.dispatch:exit:9       hard-exits the process (host death)
+    training.round_end:kill@4     4th round SIGKILLs this process (dead host)
 
 Actions: ``error[:msg]`` -> OSError, ``drop`` -> ConnectionError,
 ``sleep:<seconds>``, ``sigterm`` (os.kill SIGTERM), ``exit:<code>``
-(``os._exit`` — simulated host death, no cleanup).
+(``os._exit`` — simulated host death, no cleanup), ``kill`` (SIGKILL to
+self — the kill-rank drill helper: unlike ``exit``, not even atexit/flush
+machinery runs, exactly like a preempted or OOM-killed host; arm it on one
+rank's env to kill that specific rank deterministically).
 
 **Zero overhead when unarmed**: with ``SM_FAULT_SPEC`` unset the module
 global stays ``None`` and ``fault_point`` is a single attribute read and
@@ -41,7 +45,7 @@ logger = logging.getLogger(__name__)
 
 FAULT_SPEC_ENV = "SM_FAULT_SPEC"
 
-_ACTIONS = ("error", "drop", "sleep", "sigterm", "exit")
+_ACTIONS = ("error", "drop", "sleep", "sigterm", "exit", "kill")
 
 # None = inert (the common case); else {point: [_Rule, ...]}
 _ACTIVE = None
@@ -95,6 +99,11 @@ class _Rule:
             return
         if self.action == "exit":
             os._exit(int(self.param) if self.param else 1)
+        if self.action == "kill":
+            # the kill-rank drill: SIGKILL leaves no chance for handlers,
+            # flushes, or socket shutdowns — the honest stand-in for a
+            # preempted/OOM-killed host in elastic-membership drills
+            os.kill(os.getpid(), signal.SIGKILL)
 
 
 def _parse_entry(entry):

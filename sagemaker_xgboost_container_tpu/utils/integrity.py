@@ -145,11 +145,21 @@ def manifest_path(model_path):
     return str(model_path) + MANIFEST_SUFFIX
 
 
-def build_manifest(model_path, iteration=None, fingerprint=None, digest=None, size=None):
+def build_manifest(
+    model_path,
+    iteration=None,
+    fingerprint=None,
+    digest=None,
+    size=None,
+    membership_log=None,
+):
     """Manifest dict for a model file — THE schema definition; every writer
     (checkpoint sidecars, final-model sidecars) goes through here. ``digest``
     / ``size`` override the on-disk read for callers that measured the temp
-    file before renaming it into place."""
+    file before renaming it into place. ``membership_log`` (elastic
+    shrink-to-continue) is the append-only list of recorded world-size
+    transitions the model trained through — the artifact later resumes
+    validate ``world_size`` drift against."""
     manifest = {
         "manifest_version": MANIFEST_VERSION,
         "sha256": digest if digest is not None else file_digest(model_path),
@@ -159,6 +169,8 @@ def build_manifest(model_path, iteration=None, fingerprint=None, digest=None, si
         manifest["iteration"] = int(iteration)
     if fingerprint is not None:
         manifest["fingerprint"] = dict(fingerprint)
+    if membership_log:
+        manifest["membership_log"] = [dict(t) for t in membership_log]
     return manifest
 
 
@@ -180,13 +192,18 @@ def dump_manifest_atomic(target_path, manifest, tmp_path):
         raise
 
 
-def write_manifest(model_path, iteration=None, fingerprint=None):
+def write_manifest(model_path, iteration=None, fingerprint=None, membership_log=None):
     """Write ``model_path``'s sidecar manifest (tmp + rename, best-effort
     atomic). Used for final model artifacts in ``model_dir`` — serving's
     ``check_model_file`` digest-verifies any artifact whose manifest
     traveled with it. (Checkpoint manifests go through the checkpoint
     layer's retried atomic writer instead.)"""
-    manifest = build_manifest(model_path, iteration=iteration, fingerprint=fingerprint)
+    manifest = build_manifest(
+        model_path,
+        iteration=iteration,
+        fingerprint=fingerprint,
+        membership_log=membership_log,
+    )
     target = manifest_path(model_path)
     # dot-prefixed temp: the serving loader skips dotfiles, so a crash here
     # can never leave a file the model dir scan would try to load (nor
@@ -291,6 +308,18 @@ def config_fingerprint(train_cfg, world_size=None):
 
 
 def _live_world_size():
+    # the elastic membership plane owns the cluster world size once it is
+    # registered (it survives shrinks, and the CPU drill tiers simulate
+    # hosts without one jax process per host); jax.process_count() is the
+    # fallback for the plain multi-process path
+    try:
+        from ..training import elastic
+
+        world = elastic.world_size()
+        if world > 0:
+            return int(world)
+    except Exception:
+        pass
     try:
         import jax
 
@@ -329,7 +358,36 @@ def fingerprint_mismatches(expected, actual):
     return out
 
 
-def validate_resume(checkpoint_path, live_fingerprint):
+def _world_size_transition_recorded(old, new, membership_log):
+    """True when the recorded transitions connect checkpoint world size
+    ``old`` to live world size ``new``, in EITHER direction, chains
+    included: a checkpoint written at 8 is resumable at 6 when 8→7 and 7→6
+    are both on the log, and a checkpoint written at 2 after a recorded
+    3→2 shrink is resumable when the platform restarts the job at the
+    original 3 hosts (the resume re-shards back up — the 2 was a
+    sanctioned, recorded state, not config skew)."""
+    try:
+        old, new = int(old), int(new)
+    except (TypeError, ValueError):
+        return False
+    edges = {}
+    for t in membership_log or []:
+        try:
+            a, b = int(t["old_world_size"]), int(t["new_world_size"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        edges.setdefault(a, set()).add(b)
+        edges.setdefault(b, set()).add(a)
+    seen, frontier = {old}, [old]
+    while frontier:
+        for nxt in edges.get(frontier.pop(), ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return new in seen and new != old
+
+
+def validate_resume(checkpoint_path, live_fingerprint, membership_log=None):
     """Compare the resume candidate's manifest fingerprint to the live job.
 
     Manifest-less checkpoints (older runs) pass silently. A fingerprint
@@ -337,6 +395,13 @@ def validate_resume(checkpoint_path, live_fingerprint):
     it refuses (UserError) — resuming a hist model under different binning
     or a different objective silently changes what the remaining rounds
     optimize, the exact failure this guard exists to surface.
+
+    **Recorded membership transitions** (elastic shrink-to-continue) are the
+    one sanctioned exception: a ``world_size``-only drift covered by the
+    transition log — the live plane's (``membership_log``) or the one
+    stamped into the checkpoint's own manifest — resumes cleanly (INFO, not
+    a warning, and never a strict-mode refusal): the shrink was a recorded,
+    validated event, not config skew.
     """
     if checkpoint_path is None:
         return True
@@ -346,6 +411,22 @@ def validate_resume(checkpoint_path, live_fingerprint):
     diffs = fingerprint_mismatches(manifest["fingerprint"], live_fingerprint)
     if not diffs:
         return True
+    ws_diffs = [d for d in diffs if d[0] == "world_size"]
+    if ws_diffs and len(ws_diffs) == len(diffs):
+        transitions = list(membership_log or []) + list(
+            manifest.get("membership_log") or []
+        )
+        _key, ckpt_ws, live_ws = ws_diffs[0]
+        if _world_size_transition_recorded(ckpt_ws, live_ws, transitions):
+            logger.info(
+                "resuming from %s across a recorded membership transition "
+                "(world size %s -> %s): the shrink is on the membership log, "
+                "rows repartition over the new data axis",
+                checkpoint_path,
+                ckpt_ws,
+                live_ws,
+            )
+            return True
     detail = ", ".join(
         "{}: checkpoint={!r} live={!r}".format(k, ev, av) for k, ev, av in diffs
     )

@@ -58,9 +58,24 @@ case "$TIER" in
       python -m pytest tests/ -q "${COV_ARGS[@]}"
     ;;
   chaos)
-    # failure-domain supervision + state-integrity drills (test_robustness,
-    # test_faults, test_integrity — everything marked `chaos`)
+    # failure-domain supervision + state-integrity + elastic-membership
+    # drills (test_robustness, test_faults, test_integrity, test_elastic —
+    # everything marked `chaos`)
     python -m pytest tests/ -q -m chaos
+    rc=$?
+    if [ $rc -eq 0 ]; then
+      # elastic shrink drills standalone, archiving the membership-logged
+      # manifests and flight-recorder dumps as CI artifacts
+      if PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$REPO/scripts/elastic_drill.py" "$ARTIFACT_DIR/elastic"; then
+        echo "elastic drill: OK (artifacts: $ARTIFACT_DIR/elastic)"
+      else
+        rc=1
+        echo "CI $TIER TIER FAILED (elastic drill; see $ARTIFACT_DIR/elastic)"
+      fi
+    fi
+    # the case arm's status feeds the shared rc=$? below
+    (exit $rc)
     ;;
   *)
     echo "usage: $0 [fast|full|chaos]"; exit 2

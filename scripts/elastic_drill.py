@@ -1,0 +1,475 @@
+#!/usr/bin/env python
+"""Elastic shrink-to-continue chaos drill: SIGKILL one of N ranks mid-train.
+
+Self-spawning harness (parent mode spawns rank children of this same file)
+exercising the full elastic membership plane end to end on loopback:
+
+* ``python scripts/elastic_drill.py [artifact_dir]`` — the shrink drill:
+  3 ranks train with checkpoints; rank 2 is SIGKILLed deterministically by
+  the ``kill`` fault action at its 3rd round; rank 0's heartbeat aggregator
+  detects the stale host and proposes a survivor set (``SM_ELASTIC=1``);
+  survivors re-rendezvous at world size 2, resume from the last
+  digest-verified checkpoint across the recorded world-size transition, and
+  finish training. The parent asserts: survivors exit 0, the final model
+  loads through serving's verified path, and its manifest's
+  ``membership_log`` records exactly one 3→2 transition.
+* ``--mode legacy`` — the SAME kill with ``SM_ELASTIC`` unset: survivors
+  must take the legacy coordinated abort (exit 80) — the
+  no-behavior-change-by-default contract.
+* ``--mode reform-fail`` — the shrink drill with ``rendezvous.reform``
+  faulted on every survivor: reform exhausts its retries and every survivor
+  exits 82 (``EXIT_REFORM_FAILED``) leaving a flight-recorder dump.
+
+Artifacts (membership-logged manifests, flight-recorder dumps, per-rank
+stdout) are archived under the given directory — CI wires this into the
+chaos tier with ``${CI_ARTIFACT_DIR:-.ci-artifacts}/elastic/``.
+
+Exit code: 0 when every assertion holds, 1 otherwise (2 on usage errors).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_RANKS = 3
+NUM_ROUND = 40
+PACE_S = 0.25
+HEARTBEAT_S = 0.4
+STALE_AFTER = 3
+
+
+def _free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------- rank child
+def rank_main(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import booster
+    from sagemaker_xgboost_container_tpu.parallel.distributed import Cluster
+    from sagemaker_xgboost_container_tpu.telemetry import cluster as tcluster
+    from sagemaker_xgboost_container_tpu.training import elastic, watchdog
+    from sagemaker_xgboost_container_tpu.training.callbacks import get_callbacks
+    from sagemaker_xgboost_container_tpu.utils import integrity
+    from sagemaker_xgboost_container_tpu.utils.logging_config import (
+        setup_main_logger,
+    )
+
+    setup_main_logger("elastic_drill")
+    rank = args.rank
+    abort_ports = [int(p) for p in args.abort_ports.split(",")]
+    hosts = ["algo-{}".format(i + 1) for i in range(args.n_ranks)]
+    current = hosts[rank]
+    peer_addrs = {
+        hosts[i]: ("127.0.0.1", abort_ports[i]) for i in range(args.n_ranks)
+    }
+    ckpt_dir = os.path.join(args.workdir, "ckpt")
+    model_dir = os.path.join(args.workdir, "model")
+
+    # startup barrier first (the production analog: rendezvous precedes the
+    # telemetry plane) so heartbeat grace windows never race process spawn
+    barrier = Cluster(hosts, current, port=args.barrier_port)
+    barrier.master_host = "127.0.0.1"
+    barrier.synchronize({"host": current}, timeout=120.0)
+
+    elastic.register_cluster(hosts, current, peer_addrs=peer_addrs)
+    from sagemaker_xgboost_container_tpu.telemetry import tracing
+
+    tracing.set_rank(rank)
+    watchdog.start_abort_plane(hosts, current, port=abort_ports[rank])
+
+    def start_heartbeat_plane(cur_hosts):
+        ordered = sorted(cur_hosts)
+        my_rank = ordered.index(current)
+        aggregator = None
+        if my_rank == 0:
+            def on_stale(stale_rank, stale_host, age_s):
+                watchdog.handle_stale_host(
+                    ordered, current, stale_rank, stale_host, age_s
+                )
+
+            aggregator = tcluster.HeartbeatAggregator(
+                num_hosts=len(ordered),
+                interval=HEARTBEAT_S,
+                port=args.hb_port,
+                hosts=ordered,
+                stale_after=STALE_AFTER,
+                on_stale=on_stale,
+            ).start()
+        sender = tcluster.HeartbeatSender(
+            rank=my_rank,
+            host=current,
+            aggregator_addr=("127.0.0.1", args.hb_port),
+            interval=HEARTBEAT_S,
+        ).start()
+        # register as THE active plane so the reform teardown
+        # (elastic._teardown_planes -> stop_cluster_telemetry) stops it
+        plane = tcluster.ClusterTelemetry(
+            rank=my_rank, sender=sender, aggregator=aggregator
+        )
+        with tcluster._plane_lock:
+            tcluster._active_plane = plane
+        return plane
+
+    start_heartbeat_plane(hosts)
+
+    rng = np.random.RandomState(rank)
+    X = rng.rand(300, 4).astype(np.float32)
+    y = (3 * X[:, 0] + X[:, 1]).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    params = {"objective": "reg:squarederror", "max_depth": 2, "eta": "0.3"}
+    is_master = current == sorted(hosts)[0]
+
+    class Pacer:
+        """Slow rounds to drill speed so detection/reform land mid-train."""
+
+        def after_iteration(self, model, epoch, evals_log):
+            time.sleep(PACE_S)
+            return False
+
+    def train_once():
+        xgb_model, iteration, callbacks = get_callbacks(
+            model_dir=model_dir,
+            checkpoint_dir=ckpt_dir,
+            early_stopping_data_name=None,
+            early_stopping_metric=None,
+            early_stopping_rounds=None,
+            save_model_on_termination="false",
+            is_master=is_master,
+            num_round=NUM_ROUND,
+            num_rows=dtrain.num_row,
+            train_cfg=dict(params),
+        )
+        callbacks.insert(0, Pacer())
+        try:
+            return booster.train(
+                dict(params),
+                dtrain,
+                num_boost_round=NUM_ROUND - iteration,
+                evals=[(dtrain, "train")],
+                callbacks=callbacks,
+                xgb_model=xgb_model,
+            )
+        except elastic.ReformRequested:
+            elastic.drain_callbacks(callbacks)
+            raise
+
+    def on_reform(new_hosts, current_host):
+        watchdog.start_abort_plane(new_hosts, current_host, port=abort_ports[rank])
+        start_heartbeat_plane(new_hosts)
+
+    forest = elastic.supervised_train(
+        train_once,
+        on_reform=on_reform,
+        master_addr="127.0.0.1",
+        reform_port=args.reform_port,
+    )
+
+    if is_master:
+        os.makedirs(model_dir, exist_ok=True)
+        model_location = os.path.join(model_dir, "xgboost-model")
+        forest.save_model(model_location)
+        integrity.write_manifest(
+            model_location,
+            fingerprint=integrity.config_fingerprint(params),
+            membership_log=elastic.membership_log() or None,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "drill.done",
+                "rank": rank,
+                "world_size": elastic.world_size(),
+                "generation": elastic.generation(),
+                "rounds": forest.num_boosted_rounds,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+# ------------------------------------------------------------------- parent
+def _spawn(mode, workdir):
+    hb_port = _free_port()
+    reform_port = _free_port()
+    barrier_port = _free_port()
+    abort_ports = [_free_port() for _ in range(N_RANKS)]
+    procs = []
+    for rank in range(N_RANKS):
+        env = dict(os.environ)
+        for stale in ("SM_FAULT_SPEC", "SM_ROUND_DEADLINE_S", "SM_CONSENSUS_EVERY",
+                      "SM_HEARTBEAT_INTERVAL_S", "SM_ELASTIC"):
+            env.pop(stale, None)
+        trace_dir = os.path.join(workdir, "trace-rank{}".format(rank))
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "",
+                "PYTHONPATH": REPO,
+                "SM_ABORT_ON_STALE": "1",
+                "SM_TRACE": "1",
+                "SM_TRACE_EXPORT_DIR": trace_dir,
+                "SM_IO_RETRY_BACKOFF_S": "0.05",
+                "SM_REFORM_TIMEOUT_S": "30",
+            }
+        )
+        if mode != "legacy":
+            env["SM_ELASTIC"] = "1"
+            env["SM_ELASTIC_MIN_HOSTS"] = "2"
+        if rank == N_RANKS - 1:
+            # the kill-rank helper: SIGKILL this specific rank at its 3rd
+            # completed round — a deterministic dead host
+            env["SM_FAULT_SPEC"] = "training.round_end:kill@3"
+        elif mode == "reform-fail":
+            env["SM_FAULT_SPEC"] = "rendezvous.reform:error:injected reform outage"
+            env["SM_IO_RETRY_ATTEMPTS"] = "2"
+        out = open(os.path.join(workdir, "rank{}.out".format(rank)), "w")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        os.path.abspath(__file__),
+                        "--rank", str(rank),
+                        "--n-ranks", str(N_RANKS),
+                        "--workdir", workdir,
+                        "--hb-port", str(hb_port),
+                        "--reform-port", str(reform_port),
+                        "--barrier-port", str(barrier_port),
+                        "--abort-ports", ",".join(str(p) for p in abort_ports),
+                    ],
+                    env=env,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                ),
+                out,
+            )
+        )
+    codes = []
+    for proc, out in procs:
+        try:
+            proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        out.close()
+        codes.append(proc.returncode)
+    return codes
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def _records(text, metric):
+    prefix = '{{"metric": "{}"'.format(metric)
+    return [json.loads(l) for l in text.splitlines() if l.startswith(prefix)]
+
+
+def _check(ok, message, failures):
+    print(("ok: " if ok else "FAIL: ") + message, flush=True)
+    if not ok:
+        failures.append(message)
+    return ok
+
+
+def _verify_shrink(workdir, codes, failures):
+    killed = -signal.SIGKILL
+    _check(codes[2] == killed, "rank 2 SIGKILLed (rc={})".format(codes[2]), failures)
+    for rank in (0, 1):
+        out = _read(os.path.join(workdir, "rank{}.out".format(rank)))
+        _check(
+            codes[rank] == 0,
+            "survivor rank {} completed (rc={})".format(rank, codes[rank]),
+            failures,
+        )
+        memb = _records(out, "training.membership")
+        _check(
+            len(memb) == 1
+            and memb[0]["old_world_size"] == 3
+            and memb[0]["new_world_size"] == 2,
+            "rank {} recorded one 3->2 membership transition".format(rank),
+            failures,
+        )
+        done = _records(out, "drill.done")
+        _check(
+            done and done[0]["world_size"] == 2
+            and done[0]["rounds"] == NUM_ROUND,
+            "rank {} finished all {} rounds at world size 2".format(rank, NUM_ROUND),
+            failures,
+        )
+
+    model_path = os.path.join(workdir, "model", "xgboost-model")
+    manifest_path = model_path + ".manifest"
+    _check(os.path.exists(model_path), "final model exists", failures)
+    if os.path.exists(manifest_path):
+        manifest = json.loads(_read(manifest_path))
+        log = manifest.get("membership_log") or []
+        _check(
+            len(log) == 1
+            and log[0]["old_world_size"] == 3
+            and log[0]["new_world_size"] == 2
+            and log[0]["reason"] == "stale_host",
+            "final manifest membership_log records exactly one transition",
+            failures,
+        )
+        _check(
+            manifest.get("fingerprint", {}).get("world_size") == 2,
+            "final fingerprint carries the shrunken world size",
+            failures,
+        )
+    else:
+        _check(False, "final model manifest exists", failures)
+
+    # the model must load through serving's verified path (digest ->
+    # parse -> structural validation)
+    try:
+        from sagemaker_xgboost_container_tpu.serving import serve_utils
+
+        serve_utils._load_verified(model_path)
+        _check(True, "final model passes serving's verified load", failures)
+    except Exception as e:
+        _check(False, "final model passes serving's verified load ({})".format(e), failures)
+
+
+def _verify_legacy(workdir, codes, failures):
+    killed = -signal.SIGKILL
+    _check(codes[2] == killed, "rank 2 SIGKILLed (rc={})".format(codes[2]), failures)
+    for rank in (0, 1):
+        out = _read(os.path.join(workdir, "rank{}.out".format(rank)))
+        _check(
+            codes[rank] == 80,
+            "survivor rank {} took the legacy coordinated abort "
+            "(rc={}, want 80)".format(rank, codes[rank]),
+            failures,
+        )
+        aborts = _records(out, "training.abort")
+        _check(
+            aborts and aborts[0]["reason"] in ("stale_host",)
+            and aborts[0]["exit_code"] == 80,
+            "rank {} training.abort names stale_host/80".format(rank),
+            failures,
+        )
+        _check(
+            not _records(out, "training.membership"),
+            "rank {} recorded no membership transition".format(rank),
+            failures,
+        )
+
+
+def _verify_reform_fail(workdir, codes, failures):
+    killed = -signal.SIGKILL
+    _check(codes[2] == killed, "rank 2 SIGKILLed (rc={})".format(codes[2]), failures)
+    for rank in (0, 1):
+        out = _read(os.path.join(workdir, "rank{}.out".format(rank)))
+        _check(
+            codes[rank] == 82,
+            "survivor rank {} exits EXIT_REFORM_FAILED "
+            "(rc={}, want 82)".format(rank, codes[rank]),
+            failures,
+        )
+        aborts = _records(out, "training.abort")
+        _check(
+            aborts and aborts[0]["reason"] == "reform_failed"
+            and aborts[0]["exit_code"] == 82,
+            "rank {} training.abort names reform_failed/82".format(rank),
+            failures,
+        )
+        dump = aborts[0].get("flight_recorder") if aborts else None
+        _check(
+            bool(dump) and os.path.exists(dump),
+            "rank {} left a flight-recorder dump ({})".format(rank, dump),
+            failures,
+        )
+
+
+def _archive(workdir, artifact_dir, mode):
+    dest = os.path.join(artifact_dir, mode)
+    os.makedirs(dest, exist_ok=True)
+    for name in sorted(os.listdir(workdir)):
+        src = os.path.join(workdir, name)
+        if name.endswith(".out"):
+            shutil.copy2(src, dest)
+        elif name.startswith("trace-rank") and os.path.isdir(src):
+            for f in os.listdir(src):
+                shutil.copy2(os.path.join(src, f), os.path.join(dest, f))
+    manifest = os.path.join(workdir, "model", "xgboost-model.manifest")
+    if os.path.exists(manifest):
+        shutil.copy2(manifest, dest)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    if os.path.isdir(ckpt_dir):
+        for f in sorted(os.listdir(ckpt_dir)):
+            if f.endswith(".manifest"):
+                shutil.copy2(os.path.join(ckpt_dir, f), dest)
+    print("artifacts archived under {}".format(dest), flush=True)
+
+
+def parent_main(args):
+    failures = []
+    modes = [args.mode] if args.mode != "all" else ["shrink", "legacy", "reform-fail"]
+    artifact_dir = os.path.abspath(args.artifact_dir)
+    os.makedirs(artifact_dir, exist_ok=True)
+    for mode in modes:
+        print("--- elastic drill: {} ---".format(mode), flush=True)
+        workdir = tempfile.mkdtemp(prefix="elastic-{}-".format(mode))
+        try:
+            codes = _spawn(mode, workdir)
+            print("rank exit codes: {}".format(codes), flush=True)
+            if mode == "shrink":
+                _verify_shrink(workdir, codes, failures)
+            elif mode == "legacy":
+                _verify_legacy(workdir, codes, failures)
+            else:
+                _verify_reform_fail(workdir, codes, failures)
+            _archive(workdir, artifact_dir, mode)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        print("ELASTIC DRILL FAILED ({} assertion(s))".format(len(failures)), flush=True)
+        return 1
+    print("ELASTIC DRILL OK", flush=True)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("artifact_dir", nargs="?", default=".ci-artifacts/elastic")
+    parser.add_argument(
+        "--mode", choices=["shrink", "legacy", "reform-fail", "all"], default="all"
+    )
+    parser.add_argument("--rank", type=int, default=None)
+    parser.add_argument("--n-ranks", type=int, default=N_RANKS)
+    parser.add_argument("--workdir")
+    parser.add_argument("--hb-port", type=int)
+    parser.add_argument("--reform-port", type=int)
+    parser.add_argument("--barrier-port", type=int)
+    parser.add_argument("--abort-ports")
+    args = parser.parse_args(argv)
+    if args.rank is not None:
+        return rank_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

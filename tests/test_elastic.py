@@ -1,0 +1,720 @@
+"""Elastic shrink-to-continue suite: survivor re-rendezvous, resharded
+resume, recorded membership transitions.
+
+Unit coverage for the elastic membership plane (training/elastic.py): the
+shrink-decision gates (``SM_ELASTIC`` / floors / budget), per-generation
+reform idempotence, the shrink verb on the abort channel (including the
+you-were-declared-dead fallback), duplicate/racing abort-frame suppression,
+the loopback ``reform_cluster`` handshake (retry + generation-mismatch
+refusal), the relaxed ``validate_resume`` (world-size drift covered by a
+recorded transition), membership logs in checkpoint manifests, and the
+consensus guard's membership-drift skip. The end-to-end acceptance drills
+(3 ranks, SIGKILL one, survivors re-form at world size 2 / legacy exit 80 /
+reform-failure exit 82 with flight-recorder dumps) run through
+``scripts/elastic_drill.py`` — the same harness CI archives artifacts from.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.constants import (
+    EXIT_CLUSTER_ABORT,
+    EXIT_REFORM_FAILED,
+)
+from sagemaker_xgboost_container_tpu.parallel.distributed import (
+    AbortListener,
+    frame_message,
+    reform_cluster,
+)
+from sagemaker_xgboost_container_tpu.telemetry import REGISTRY
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+from sagemaker_xgboost_container_tpu.training import consensus, elastic, watchdog
+from sagemaker_xgboost_container_tpu.training.checkpointing import (
+    MANIFEST_SUFFIX,
+    SaveCheckpointCallBack,
+    _atomic_save,
+)
+from sagemaker_xgboost_container_tpu.utils import faults, integrity
+from tests.util_ports import free_port
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.setenv("SM_IO_RETRY_BACKOFF_S", "0.001")
+    elastic._reset_for_tests()
+    consensus._reset_for_tests()
+    watchdog._reset_abort_for_tests()
+    yield
+    faults.reset()
+    elastic._reset_for_tests()
+    consensus._reset_for_tests()
+    watchdog._reset_abort_for_tests()
+    watchdog.stop_abort_plane()
+
+
+def _enable(monkeypatch, min_hosts=1, max_shrinks=2):
+    monkeypatch.setenv(elastic.ELASTIC_ENV, "1")
+    monkeypatch.setenv(elastic.ELASTIC_MIN_HOSTS_ENV, str(min_hosts))
+    monkeypatch.setenv(elastic.ELASTIC_MAX_SHRINKS_ENV, str(max_shrinks))
+
+
+class _JsonModel:
+    def save_model(self, path):
+        with open(path, "w") as f:
+            json.dump({"tag": "m"}, f)
+
+
+# ------------------------------------------------------------- config + gates
+
+
+def test_resolve_elastic_config_defaults_and_clamps(monkeypatch):
+    for var in (
+        elastic.ELASTIC_ENV,
+        elastic.ELASTIC_MIN_HOSTS_ENV,
+        elastic.ELASTIC_MAX_SHRINKS_ENV,
+        elastic.REFORM_TIMEOUT_ENV,
+    ):
+        monkeypatch.delenv(var, raising=False)
+    cfg = elastic.resolve_elastic_config()
+    assert cfg.enabled is False and cfg.min_hosts == 1 and cfg.max_shrinks == 2
+    monkeypatch.setenv(elastic.ELASTIC_ENV, "1")
+    monkeypatch.setenv(elastic.ELASTIC_MIN_HOSTS_ENV, "0")  # clamps to 1
+    monkeypatch.setenv(elastic.REFORM_TIMEOUT_ENV, "0.01")  # clamps to 1.0
+    cfg = elastic.resolve_elastic_config()
+    assert cfg.enabled is True and cfg.min_hosts == 1
+    assert cfg.reform_timeout_s == 1.0
+
+
+def test_propose_survivors_gates(monkeypatch):
+    hosts = ["algo-1", "algo-2", "algo-3"]
+    # not enabled -> no proposal (legacy exit-80 applies)
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    elastic.register_cluster(hosts, "algo-1")
+    assert elastic.propose_survivors("algo-3") is None
+    # enabled: the stale host leaves, everyone else survives
+    elastic._reset_for_tests()
+    _enable(monkeypatch, min_hosts=2)
+    elastic.register_cluster(hosts, "algo-1")
+    assert elastic.propose_survivors("algo-3") == ["algo-1", "algo-2"]
+    # unknown host (already shrunk away) -> ignore
+    assert elastic.propose_survivors("algo-9") is None
+    # floor: shrinking 2 -> 1 under min_hosts=2 is refused
+    elastic._reset_for_tests()
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    assert elastic.propose_survivors("algo-2") is None
+
+
+def test_propose_survivors_budget_exhausted(monkeypatch):
+    _enable(monkeypatch, min_hosts=1, max_shrinks=0)
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    assert elastic.propose_survivors("algo-2") is None
+
+
+def test_request_reform_idempotent_per_generation(monkeypatch):
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2", "algo-3"], "algo-1")
+    assert elastic.request_reform(["algo-1", "algo-2"], "stale_host", generation=1)
+    # duplicate and stale generations are no-ops
+    assert not elastic.request_reform(["algo-1", "algo-2"], "stale_host", generation=1)
+    assert not elastic.request_reform(["algo-1"], "whatever", generation=0)
+    pending = elastic.pending_reform()
+    assert pending["generation"] == 1
+    assert pending["survivors"] == ["algo-1", "algo-2"]
+
+
+def test_membership_callback_raises_at_round_boundary(monkeypatch):
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    cb = elastic.maybe_elastic_callback()
+    assert cb is not None
+    assert cb.after_iteration(None, 0, {}) is False  # nothing pending
+    elastic.request_reform(["algo-1"], "stale_host", generation=1)
+    with pytest.raises(elastic.ReformRequested) as e:
+        cb.after_iteration(None, 7, {})
+    assert e.value.survivors == ["algo-1"]
+    assert e.value.generation == 1 and e.value.epoch == 7
+
+
+def test_maybe_elastic_callback_inert_by_default(monkeypatch):
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    assert elastic.maybe_elastic_callback() is None
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    assert elastic.maybe_elastic_callback() is None  # registered but not enabled
+
+
+# ------------------------------------------------------- shrink frame handling
+
+
+def test_on_shrink_frame_arms_reform(monkeypatch):
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2", "algo-3"], "algo-2")
+    watchdog._on_abort_frame(
+        {
+            "type": "abort",
+            "verb": "shrink",
+            "reason": "stale_host",
+            "survivors": ["algo-1", "algo-2"],
+            "generation": 1,
+            "source": "algo-1",
+        }
+    )
+    pending = elastic.pending_reform()
+    assert pending is not None and pending["generation"] == 1
+
+
+def test_on_shrink_frame_excluding_self_aborts_with_cluster_code(monkeypatch):
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2", "algo-3"], "algo-3")
+    elastic.on_shrink_frame(
+        {
+            "type": "abort",
+            "verb": "shrink",
+            "survivors": ["algo-1", "algo-2"],
+            "generation": 1,
+            "source": "algo-1",
+        }
+    )
+    assert codes == [EXIT_CLUSTER_ABORT]
+    assert elastic.pending_reform() is None
+
+
+def test_handle_stale_host_decides_shrink_vs_abort(monkeypatch):
+    aborts, shrinks = [], []
+    monkeypatch.setattr(
+        watchdog,
+        "coordinate_abort",
+        lambda *a, **k: aborts.append((a, k)),
+    )
+    monkeypatch.setattr(
+        elastic, "coordinate_shrink", lambda *a, **k: shrinks.append((a, k))
+    )
+    hosts = ["algo-1", "algo-2", "algo-3"]
+    # elastic off: legacy coordinated abort, unchanged
+    monkeypatch.delenv(elastic.ELASTIC_ENV, raising=False)
+    elastic.register_cluster(hosts, "algo-1")
+    watchdog.handle_stale_host(hosts, "algo-1", 2, "algo-3", 9.0)
+    assert len(aborts) == 1 and not shrinks
+    # elastic on: survivor-set proposal instead
+    elastic._reset_for_tests()
+    _enable(monkeypatch, min_hosts=2)
+    elastic.register_cluster(hosts, "algo-1")
+    watchdog.handle_stale_host(hosts, "algo-1", 2, "algo-3", 9.0)
+    assert len(shrinks) == 1 and len(aborts) == 1
+    assert shrinks[0][0][0] == ["algo-1", "algo-2"]
+
+
+def test_coordinate_shrink_notifies_survivors_and_excluded_host(monkeypatch):
+    """Rank 0's fan-out reaches EVERY member's abort listener: survivors
+    arm their reform, and the excluded (declared-dead) host — which may be
+    merely partitioned — learns its verdict instead of zombie-training."""
+    survivor_frames, excluded_frames = [], []
+    survivor = AbortListener(handler=survivor_frames.append, port=0).start()
+    excluded = AbortListener(handler=excluded_frames.append, port=0).start()
+    try:
+        _enable(monkeypatch, min_hosts=1)
+        elastic.register_cluster(
+            ["algo-1", "algo-2", "algo-3"],
+            "algo-1",
+            peer_addrs={
+                "algo-2": ("127.0.0.1", survivor.port),
+                "algo-3": ("127.0.0.1", excluded.port),
+            },
+        )
+        elastic.coordinate_shrink(["algo-1", "algo-2"], "stale_host", epoch=4)
+        deadline = time.monotonic() + 5
+        while (
+            not (survivor_frames and excluded_frames)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert survivor_frames and survivor_frames[0]["verb"] == "shrink"
+        assert survivor_frames[0]["survivors"] == ["algo-1", "algo-2"]
+        assert survivor_frames[0]["generation"] == 1
+        # the false-stale host gets the same frame; its on_shrink_frame
+        # takes the excluded branch (exit 80) — asserted in
+        # test_on_shrink_frame_excluding_self_aborts_with_cluster_code
+        assert excluded_frames and excluded_frames[0]["verb"] == "shrink"
+        # the proposer armed its own reform too
+        assert elastic.pending_reform()["generation"] == 1
+    finally:
+        survivor.stop()
+        excluded.stop()
+
+
+def test_handle_stale_host_defers_while_reform_in_flight(monkeypatch):
+    """One transition at a time: a second stale verdict mid-reform must be
+    deferred (re-detected post-reform), never folded into the same
+    generation or escalated to an abort."""
+    aborts, shrinks = [], []
+    monkeypatch.setattr(watchdog, "coordinate_abort", lambda *a, **k: aborts.append(a))
+    monkeypatch.setattr(elastic, "coordinate_shrink", lambda *a, **k: shrinks.append(a))
+    hosts = ["algo-1", "algo-2", "algo-3", "algo-4"]
+    _enable(monkeypatch, min_hosts=1, max_shrinks=4)
+    elastic.register_cluster(hosts, "algo-1")
+    elastic.request_reform(["algo-1", "algo-2", "algo-3"], "stale_host", generation=1)
+    watchdog.handle_stale_host(hosts, "algo-1", 2, "algo-3", 9.0)
+    assert not aborts and not shrinks
+
+
+# ----------------------------------------------- abort listener idempotence
+
+
+def test_abort_listener_suppresses_duplicate_frames():
+    """Two ranks detecting the same dead host broadcast frames differing
+    only in source: the handler must fire once; a genuinely different frame
+    still passes."""
+    received = []
+    listener = AbortListener(handler=received.append, port=0).start()
+    try:
+        base = {"type": "abort", "reason": "stale_host", "exit_code": 80}
+
+        def send(frame):
+            s = socket.create_connection(("127.0.0.1", listener.port), timeout=5)
+            s.sendall(frame_message(frame))
+            s.close()
+
+        send(dict(base, source="algo-1"))
+        send(dict(base, source="algo-2"))  # same event, other detector
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.3)  # allow the duplicate to (not) land
+        assert len(received) == 1
+        send({"type": "abort", "reason": "consensus_divergence", "exit_code": 81})
+        deadline = time.monotonic() + 5
+        while len(received) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(received) == 2
+    finally:
+        listener.stop()
+
+
+def test_abort_listener_concurrent_racing_frames_fire_once():
+    """Racing deliveries of the same event (thread-level, no socket timing
+    luck): exactly one handler call, first-wins."""
+    calls = []
+    listener = AbortListener(handler=calls.append, port=0)
+    frame = {"type": "abort", "reason": "stale_host", "exit_code": 80}
+    threads = [
+        threading.Thread(
+            target=listener._dispatch,
+            args=(dict(frame, source="algo-{}".format(i)), ("127.0.0.1", 1000 + i)),
+        )
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1
+    listener.stop()
+
+
+# --------------------------------------------------------- reform handshake
+
+
+def test_reform_cluster_loopback_allgather_with_retry():
+    """Two survivors re-rendezvous over real sockets; one transient fault at
+    the ``rendezvous.reform`` point is absorbed by the retry budget."""
+    faults.configure("rendezvous.reform:error:transient bind race@1")
+    port = free_port()
+    hosts = ["algo-1", "algo-2"]
+    results, errors = {}, []
+
+    def run(rank):
+        try:
+            cluster, membership = reform_cluster(
+                hosts,
+                hosts[rank],
+                generation=3,
+                payload={"resume_iteration": 5},
+                port=port,
+                timeout=15.0,
+                master_addr="127.0.0.1",
+            )
+            results[rank] = (cluster.num_hosts, membership)
+        except Exception as e:  # surfaced via the assertion below
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    threads[0].start()
+    time.sleep(0.2)
+    threads[1].start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert results[0][0] == results[1][0] == 2
+    assert [m["host"] for m in results[0][1]] == hosts
+    assert all(m["generation"] == 3 for m in results[0][1])
+    assert all(m["resume_iteration"] == 5 for m in results[1][1])
+    assert faults.fault_counts()["rendezvous.reform"] == 1
+
+
+def test_reform_cluster_refuses_mixed_generations():
+    """A survivor that missed a shrink answers with the wrong generation:
+    both sides must refuse to re-form rather than disagree on world size."""
+    port = free_port()
+    hosts = ["algo-1", "algo-2"]
+    errors = {}
+
+    def run(rank, generation):
+        try:
+            reform_cluster(
+                hosts, hosts[rank], generation=generation, port=port,
+                timeout=10.0, master_addr="127.0.0.1",
+            )
+        except exc.PlatformError as e:
+            errors[rank] = str(e)
+
+    threads = [
+        threading.Thread(target=run, args=(0, 2)),
+        threading.Thread(target=run, args=(1, 1)),
+    ]
+    threads[0].start()
+    time.sleep(0.2)
+    threads[1].start()
+    for t in threads:
+        t.join(timeout=30)
+    assert set(errors) == {0, 1}
+    assert "mixed shrink generations" in errors[0]
+
+
+def test_perform_reform_success_commits_transition(monkeypatch, capsys):
+    _enable(monkeypatch, min_hosts=1)
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    rewired = []
+    req = elastic.ReformRequested(["algo-1"], "stale_host", 1, epoch=6)
+    before = REGISTRY.counter(
+        "elastic_shrink_total", labels={"reason": "stale_host"}
+    ).value
+    elastic.perform_reform(req, on_reform=lambda hosts, cur: rewired.append(hosts))
+    assert rewired == [["algo-1"]]
+    assert elastic.world_size() == 1 and elastic.generation() == 1
+    assert elastic.pending_reform() is None
+    log = elastic.membership_log()
+    assert len(log) == 1
+    assert log[0]["old_world_size"] == 2 and log[0]["new_world_size"] == 1
+    assert log[0]["epoch"] == 6 and log[0]["surviving_ranks"] == [0]
+    assert (
+        REGISTRY.counter("elastic_shrink_total", labels={"reason": "stale_host"}).value
+        == before + 1
+    )
+    assert REGISTRY.gauge("cluster_world_size").value == 1
+    # consensus membership followed the shrink
+    guard_hosts = consensus._hosts
+    assert guard_hosts == ["algo-1"]
+    records = [
+        json.loads(l)
+        for l in capsys.readouterr().out.splitlines()
+        if l.startswith('{"metric": "training.membership"')
+    ]
+    assert len(records) == 1 and records[0]["new_world_size"] == 1
+
+
+def test_perform_reform_failure_exits_82(monkeypatch):
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "2")
+    faults.configure("rendezvous.reform:error:injected reform outage")
+    _enable(monkeypatch, min_hosts=1)
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    req = elastic.ReformRequested(["algo-1"], "stale_host", 1, epoch=2)
+    with pytest.raises(OSError):
+        elastic.perform_reform(req)
+    assert codes == [EXIT_REFORM_FAILED]
+    # the failed reform must NOT have committed a transition
+    assert elastic.membership_log() == []
+    assert elastic.world_size() == 2
+
+
+def test_drain_deadline_demotes_wedged_shrink_to_cluster_abort(monkeypatch):
+    """A survivor wedged inside the poisoned collective never reaches the
+    round-boundary drain: the armed reform must demote to the legacy
+    coordinated abort (exit 80) instead of hanging forever — with
+    SM_ELASTIC on, a dead host can never be WORSE than with it off."""
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    monkeypatch.setenv(elastic.REFORM_DRAIN_TIMEOUT_ENV, "1")  # clamps min
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2", "algo-3"], "algo-2")
+    assert elastic.request_reform(["algo-1", "algo-2"], "stale_host", generation=1)
+    deadline = time.monotonic() + 10
+    while not codes and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert codes == [EXIT_CLUSTER_ABORT]
+
+
+def test_drain_deadline_disarmed_by_reform_consumption(monkeypatch):
+    """A reform that IS consumed (perform_reform runs) must not be demoted
+    when the drain timer later fires."""
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    monkeypatch.setenv(elastic.REFORM_DRAIN_TIMEOUT_ENV, "1")
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2"], "algo-1")
+    assert elastic.request_reform(["algo-1"], "stale_host", generation=1)
+    req = elastic.ReformRequested(["algo-1"], "stale_host", 1, epoch=3)
+    elastic.perform_reform(req)  # single-survivor rendezvous short-circuits
+    time.sleep(1.3)  # past the drain deadline
+    assert codes == []
+    assert elastic.world_size() == 1
+
+
+def test_supervised_train_passthrough_without_reform():
+    calls = []
+
+    def train_once():
+        calls.append(1)
+        return "forest"
+
+    assert elastic.supervised_train(train_once) == "forest"
+    assert calls == [1]
+
+
+def test_supervised_train_disarms_reform_racing_the_last_round(monkeypatch):
+    """A shrink verdict landing during/after the FINAL round is never
+    consumed at a round boundary: normal completion must disarm it (and
+    its drain timer) so a finished job can't be exit-80'd mid model-save."""
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    monkeypatch.setenv(elastic.REFORM_DRAIN_TIMEOUT_ENV, "1")
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2", "algo-3"], "algo-1")
+
+    def train_once():
+        # the verdict arrives mid-final-round; no after_iteration remains
+        elastic.request_reform(["algo-1", "algo-2"], "stale_host", generation=1)
+        return "forest"
+
+    assert elastic.supervised_train(train_once) == "forest"
+    assert elastic.pending_reform() is None
+    time.sleep(1.3)  # past the drain deadline: the timer must NOT fire
+    assert codes == []
+
+
+# ------------------------------------------------- resume + manifest plumbing
+
+
+def _save_ckpt(tmp_path, name="xgboost-checkpoint.0", world_size=3, membership_log=None):
+    fp = {
+        "objective": "reg:squarederror",
+        "tree_method": "auto",
+        "max_bin": "",
+        "max_depth": "",
+        "world_size": world_size,
+        "jax_version": integrity._jax_version(),
+        "package_version": integrity._package_version(),
+    }
+    _atomic_save(
+        _JsonModel(), str(tmp_path), name, iteration=0, fingerprint=fp,
+        membership_log=membership_log,
+    )
+    return str(tmp_path / name), fp
+
+
+def test_validate_resume_accepts_recorded_world_size_transition(tmp_path, monkeypatch, caplog):
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    path, fp = _save_ckpt(tmp_path, world_size=3)
+    live = dict(fp, world_size=2)
+    log = [{"old_world_size": 3, "new_world_size": 2, "generation": 1}]
+    with caplog.at_level("INFO"):
+        assert integrity.validate_resume(path, live, membership_log=log) is True
+    assert any("recorded membership transition" in r.message for r in caplog.records)
+    assert not any("mismatch" in r.message for r in caplog.records)
+
+
+def test_validate_resume_accepts_chained_transitions(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    path, fp = _save_ckpt(tmp_path, world_size=4)
+    live = dict(fp, world_size=2)
+    log = [
+        {"old_world_size": 4, "new_world_size": 3},
+        {"old_world_size": 3, "new_world_size": 2},
+    ]
+    assert integrity.validate_resume(path, live, membership_log=log) is True
+
+
+def test_validate_resume_reads_transition_from_checkpoint_manifest(tmp_path, monkeypatch):
+    """A restart AFTER a shrink has no live log — the transition stamped
+    into the checkpoint's own manifest must carry the proof."""
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    log = [{"old_world_size": 3, "new_world_size": 2, "generation": 1}]
+    path, fp = _save_ckpt(tmp_path, world_size=3, membership_log=log)
+    live = dict(fp, world_size=2)
+    assert integrity.validate_resume(path, live) is True
+
+
+def test_validate_resume_unrecorded_world_size_still_refuses(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    path, fp = _save_ckpt(tmp_path, world_size=3)
+    live = dict(fp, world_size=2)
+    with pytest.raises(exc.UserError, match="fingerprint disagrees"):
+        integrity.validate_resume(path, live)
+    # a transition between unrelated sizes doesn't connect 3 and 2
+    log = [{"old_world_size": 5, "new_world_size": 4}]
+    with pytest.raises(exc.UserError):
+        integrity.validate_resume(path, live, membership_log=log)
+
+
+def test_validate_resume_accepts_grow_back_restart(tmp_path, monkeypatch):
+    """Post-shrink restart at the ORIGINAL fleet size: the checkpoint was
+    written at the shrunken world, the platform brought all hosts back —
+    a recorded transition sanctions the resume in either direction."""
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    log = [{"old_world_size": 3, "new_world_size": 2, "generation": 1}]
+    path, fp = _save_ckpt(tmp_path, world_size=2, membership_log=log)
+    live = dict(fp, world_size=3)
+    assert integrity.validate_resume(path, live) is True
+
+
+def test_validate_resume_transition_does_not_mask_other_drift(tmp_path, monkeypatch):
+    """A recorded transition relaxes ONLY world_size: combined with an
+    objective change the resume is still config skew."""
+    monkeypatch.setenv("SM_RESUME_STRICT", "true")
+    path, fp = _save_ckpt(tmp_path, world_size=3)
+    live = dict(fp, world_size=2, objective="binary:logistic")
+    log = [{"old_world_size": 3, "new_world_size": 2}]
+    with pytest.raises(exc.UserError):
+        integrity.validate_resume(path, live, membership_log=log)
+
+
+def test_checkpoint_saver_stamps_membership_log(tmp_path):
+    transitions = [
+        {"old_world_size": 3, "new_world_size": 2, "generation": 1, "reason": "stale_host"}
+    ]
+    saver = SaveCheckpointCallBack(
+        str(tmp_path), membership_provider=lambda: list(transitions)
+    )
+    try:
+        saver.after_iteration(_JsonModel(), 0, {})
+    finally:
+        saver.stop()
+    manifest = integrity.read_manifest(str(tmp_path / "xgboost-checkpoint.0"))
+    assert manifest["membership_log"] == transitions
+    # empty log -> no key (manifest shape unchanged for non-elastic jobs)
+    saver2 = SaveCheckpointCallBack(str(tmp_path), membership_provider=lambda: [])
+    try:
+        saver2.after_iteration(_JsonModel(), 1, {})
+    finally:
+        saver2.stop()
+    manifest2 = integrity.read_manifest(str(tmp_path / "xgboost-checkpoint.1"))
+    assert "membership_log" not in manifest2
+
+
+def test_config_fingerprint_world_size_follows_elastic_membership(monkeypatch):
+    _enable(monkeypatch)
+    elastic.register_cluster(["algo-1", "algo-2", "algo-3"], "algo-1")
+    assert integrity.config_fingerprint({})["world_size"] == 3
+    elastic._reset_for_tests()
+    assert integrity.config_fingerprint({})["world_size"] == 1  # jax fallback
+
+
+def test_consensus_skips_on_world_size_drift(caplog):
+    """A rank answering with a different world size is membership drift,
+    not divergence — the check skips instead of aborting a healthy mesh."""
+    guard = consensus.ConsensusGuard(
+        every=1,
+        hosts=["algo-1", "algo-2"],
+        current_host="algo-1",
+        exchange=lambda digest, rnd: [
+            {"digest": digest, "round": rnd, "world": 2},
+            {"digest": "f" * 64, "round": rnd, "world": 3},  # stale membership
+        ],
+        abort_fn=lambda *a, **k: pytest.fail("membership drift must not abort"),
+    )
+
+    class _M:
+        trees = None
+        weights = np.zeros(1)
+
+    with caplog.at_level("WARNING"):
+        assert guard.after_iteration(_M(), 0, {}) is False
+    assert any("mixed world sizes" in r.message for r in caplog.records)
+    assert guard.divergences == 0
+
+
+def test_kill_fault_action_parses_and_sigkills_subprocess(tmp_path):
+    """The kill-rank drill helper: ``kill`` parses, and firing it in a
+    child delivers an uncatchable SIGKILL (rc -9)."""
+    rules = faults.configure("x.y:kill@2")
+    assert rules and rules["x.y"][0].action == "kill"
+    faults.reset()
+    code = (
+        "from sagemaker_xgboost_container_tpu.utils import faults\n"
+        "faults.configure('p.q:kill')\n"
+        "faults.fault_point('p.q')\n"
+        "print('unreachable')\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    result = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert result.returncode == -9
+    assert "unreachable" not in result.stdout
+
+
+# ------------------------------------------------------- end-to-end drills
+
+
+def _run_drill(mode, artifact_dir):
+    env = dict(os.environ)
+    env.pop("SM_FAULT_SPEC", None)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""})
+    return subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "elastic_drill.py"),
+            str(artifact_dir),
+            "--mode",
+            mode,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=400,
+    )
+
+
+def test_drill_shrink_to_continue(tmp_path):
+    """Acceptance: SIGKILL 1 of 3 ranks mid-training with SM_ELASTIC=1 ->
+    survivors re-form at world size 2, training completes, the final model
+    passes the verified load, and the manifest records ONE transition."""
+    result = _run_drill("shrink", tmp_path / "artifacts")
+    assert result.returncode == 0, result.stdout[-4000:] + result.stderr[-2000:]
+    assert "ELASTIC DRILL OK" in result.stdout
+    # the CI artifact contract: membership-logged manifest archived
+    archived = os.listdir(str(tmp_path / "artifacts" / "shrink"))
+    assert "xgboost-model.manifest" in archived
+
+
+def test_drill_legacy_exit_80_when_elastic_unset(tmp_path):
+    """Acceptance: the IDENTICAL kill with SM_ELASTIC unset still takes the
+    legacy coordinated abort — no behavior change by default."""
+    result = _run_drill("legacy", tmp_path / "artifacts")
+    assert result.returncode == 0, result.stdout[-4000:] + result.stderr[-2000:]
+    assert "ELASTIC DRILL OK" in result.stdout
+
+
+def test_drill_reform_failure_exits_82_with_flight_recorder(tmp_path):
+    """Acceptance: reform itself faulted -> every survivor exits 82 and
+    leaves a flight-recorder dump."""
+    result = _run_drill("reform-fail", tmp_path / "artifacts")
+    assert result.returncode == 0, result.stdout[-4000:] + result.stderr[-2000:]
+    assert "ELASTIC DRILL OK" in result.stdout
+    archived = os.listdir(str(tmp_path / "artifacts" / "reform-fail"))
+    assert any(f.startswith("flight-recorder") for f in archived)

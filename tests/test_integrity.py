@@ -579,9 +579,11 @@ def test_consensus_cluster_exchange_over_real_sockets():
     threads[1].start()
     for t in threads:
         t.join(timeout=20)
+    # "world" rides along since the elastic plane: membership drift must be
+    # distinguishable from tree divergence
     expected = [
-        {"digest": "digest-0", "round": 4},
-        {"digest": "digest-1", "round": 4},
+        {"digest": "digest-0", "round": 4, "world": 2},
+        {"digest": "digest-1", "round": 4, "world": 2},
     ]
     assert results[0] == results[1] == expected
 

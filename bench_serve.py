@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""Serving latency benchmark: p50/p99 of POST /invocations.
+"""Serving latency benchmark: p50/p99 of POST /invocations + restart churn.
 
 BASELINE.md's second metric ("p50 serve-predict latency"). Runs the real
 threaded WSGI server in-process against a trained abalone-sized model and
 measures end-to-end HTTP latency for single-row csv payloads, then a batch
-payload. Prints one JSON line (not the driver contract — bench.py is that;
-this is the measurement tool for serving work).
+payload, then a **churn leg**: a rolling SIGTERM-restart cycle (graceful
+drain via serving/lifecycle.py) under continuous client load, reporting the
+p95 and error rate a fleet would see across deploys. Prints one JSON line
+(not the driver contract — bench.py is that; this is the measurement tool
+for serving work).
 """
 
 import json
@@ -19,6 +22,87 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np  # noqa: E402
 
 N_REQUESTS = int(os.getenv("BENCH_SERVE_REQUESTS", "300"))
+CHURN_CYCLES = int(os.getenv("BENCH_SERVE_CHURN_CYCLES", "3"))
+
+
+def _churn_leg(model_dir, single_payload):
+    """Rolling drain-restart cycles under load -> (p95_ms, error_rate, n).
+
+    Each cycle: a fresh server + lifecycle, two client threads hammering
+    /invocations, then a mid-traffic graceful drain (the SIGTERM sequence,
+    invoked directly) and a restart. Non-200s and connection errors — the
+    503s clients see while draining and the refused connects in the restart
+    gap — count as errors: that's the fleet's view of a deploy.
+    """
+    import urllib.error
+    import urllib.request
+    from wsgiref.simple_server import make_server
+
+    from sagemaker_xgboost_container_tpu.serving import lifecycle
+    from sagemaker_xgboost_container_tpu.serving.app import ScoringService, make_app
+    from sagemaker_xgboost_container_tpu.serving.server import (
+        _QuietHandler,
+        _ThreadedWSGIServer,
+        drain_and_shutdown,
+    )
+
+    latencies = []
+    outcomes = []  # True = 200 with a body
+    lock = threading.Lock()
+
+    for _cycle in range(CHURN_CYCLES):
+        lc = lifecycle.install(lifecycle.ServingLifecycle())
+        app = make_app(ScoringService(model_dir))
+        httpd = make_server(
+            "127.0.0.1", 0, app,
+            server_class=_ThreadedWSGIServer, handler_class=_QuietHandler,
+        )
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = "http://127.0.0.1:{}/invocations".format(port)
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                req = urllib.request.Request(
+                    url, data=single_payload, method="POST",
+                    headers={"Content-Type": "text/csv"},
+                )
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        resp.read()
+                        ok = resp.status == 200
+                except Exception:
+                    ok = False
+                elapsed = time.perf_counter() - t0
+                with lock:
+                    outcomes.append(ok)
+                    if ok:
+                        latencies.append(elapsed)
+                if not ok:
+                    # client retry backoff: without it a refused connect in
+                    # the restart gap becomes a tight error loop that swamps
+                    # the rate with thousands of sub-ms failures no real
+                    # load-balancer client would issue
+                    time.sleep(0.02)
+
+        clients = [threading.Thread(target=client, daemon=True) for _ in range(2)]
+        for t in clients:
+            t.start()
+        time.sleep(0.5)  # steady-state traffic
+        drain_and_shutdown(httpd, lc)  # the SIGTERM sequence, in-process
+        time.sleep(0.1)  # restart gap: connects here fail, and that counts
+        stop.set()
+        for t in clients:
+            t.join(timeout=15)
+        lifecycle.uninstall()
+
+    total = len(outcomes)
+    errors = total - sum(outcomes)
+    lat = sorted(latencies)
+    p95 = lat[max(0, int(len(lat) * 0.95) - 1)] * 1000 if lat else float("nan")
+    return round(p95, 2), round(errors / total, 4) if total else 1.0, total
 
 
 def main():
@@ -96,6 +180,10 @@ def main():
     post(batch)
     blat = sorted(post(batch) for _ in range(50))
     httpd.shutdown()
+    httpd.server_close()
+
+    # churn leg: p95 + error rate across rolling graceful-restart cycles
+    churn_p95_ms, churn_error_rate, churn_requests = _churn_leg(model_dir, single)
     print(
         json.dumps(
             {
@@ -104,6 +192,10 @@ def main():
                 ),
                 **results,
                 "p50_batch256_ms": round(blat[len(blat) // 2] * 1000, 2),
+                "churn_p95_ms": churn_p95_ms,
+                "churn_error_rate": churn_error_rate,
+                "churn_requests": churn_requests,
+                "churn_cycles": CHURN_CYCLES,
                 "unit": "ms",
             }
         )

@@ -150,3 +150,5 @@ EXIT_ROUND_DEADLINE = 79  # round watchdog: a boosting round exceeded its deadli
 EXIT_CLUSTER_ABORT = 80   # coordinated abort: rank 0 declared a peer dead
 EXIT_CONSENSUS_DIVERGENCE = 81  # cross-rank tree-digest guard: ranks committed different ensembles
 EXIT_REFORM_FAILED = 82   # elastic shrink: survivor re-rendezvous failed; restart at the old membership
+EXIT_DRAIN_TIMEOUT = 83   # serving drain: in-flight requests still wedged past SM_DRAIN_TIMEOUT_S
+EXIT_PREDICT_STUCK = 84   # serving watchdog: a predict dispatch wedged past SM_PREDICT_STUCK_S (abort action)

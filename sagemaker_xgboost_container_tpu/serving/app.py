@@ -25,7 +25,9 @@ import threading
 from .. import constants
 from ..telemetry import instrument_wsgi
 from ..toolkit import exceptions as exc
-from . import serve_utils
+from ..utils.faults import fault_point
+from . import lifecycle, serve_utils
+from .lifecycle import DeadlineExceeded
 
 logger = logging.getLogger(__name__)
 
@@ -82,8 +84,13 @@ class ScoringService:
                             "SAGEMAKER_MODEL_JOB_QUEUE_SIZE", 100, minimum=1
                         ),
                     )
+                    # predict watchdog (SM_PREDICT_STUCK_S): a wedged
+                    # dispatch trips THIS breaker so /ping flips + sheds
+                    lifecycle.register_batcher("single", self._batcher, self.breaker)
                 # compile the first device buckets off the request path
                 serve_utils.warmup_predict_async(self.model)
+                # first successful load: the lifecycle leaves `starting`
+                lifecycle.mark_ready()
         return self.model_format
 
     @property
@@ -96,7 +103,7 @@ class ScoringService:
         model = self.model[0] if isinstance(self.model, list) else self.model
         return str(model.num_class or "") if model else ""
 
-    def predict(self, dtest, content_type):
+    def predict(self, dtest, content_type, deadline=None):
         if self._batcher is not None:
             from ..data.content_types import get_content_type
 
@@ -104,10 +111,15 @@ class ScoringService:
                 self.model, dtest, get_content_type(content_type)
             )
             feats = serve_utils.canonicalize_features(self.model, dtest)
-            return self._batcher.predict(feats)
-        return serve_utils.predict(
+            return self._batcher.predict(feats, deadline=deadline)
+        result = serve_utils.predict(
             self.model, self.model_format, dtest, content_type, objective=self.objective
         )
+        if deadline is not None:
+            # the direct path can't be interrupted mid-predict; bill the
+            # stage after the fact so expiry still answers 503, not a slow 200
+            deadline.check("predict")
+        return result
 
 
 def _response(start_response, status, body=b"", content_type="text/plain", extra_headers=None):
@@ -130,6 +142,20 @@ def _shed_response(start_response, breaker, detail):
         http.client.SERVICE_UNAVAILABLE,
         "Temporarily overloaded: {}. Retry after the indicated delay.".format(detail),
         extra_headers=[("Retry-After", str(breaker.retry_after_s()))],
+    )
+
+
+def _drain_response(start_response):
+    """503 + Retry-After while draining/stopped: the load balancer must
+    deregister this instance and route the retry elsewhere
+    (docs/robustness.md §Serving lifecycle)."""
+    from .breaker import retry_after_hint
+
+    return _response(
+        start_response,
+        http.client.SERVICE_UNAVAILABLE,
+        "draining: instance is shutting down",
+        extra_headers=[("Retry-After", str(retry_after_hint()))],
     )
 
 
@@ -169,10 +195,17 @@ def make_app(scoring_service=None, hooks=None):
     from .batcher import JobQueueFull
 
     def handle_invocations(environ, start_response):
+        if not lifecycle.accepting():
+            # draining/stopped: new work is refused so in-flight requests
+            # can settle before the listener closes (SIGTERM drain)
+            return _drain_response(start_response)
         if breaker is not None and not breaker.allow():
             # open breaker: shed before decode — the whole point is that a
             # drowning instance stops paying per-request parse costs
             return _shed_response(start_response, breaker, "shedding load")
+        # per-request budget (SM_REQUEST_DEADLINE_S): stages draw down one
+        # shared deadline; None when the knob is unset (zero overhead)
+        deadline = lifecycle.request_deadline()
         payload = _read_body(environ)
         if len(payload) == 0:
             return _response(start_response, http.client.NO_CONTENT)
@@ -201,6 +234,8 @@ def make_app(scoring_service=None, hooks=None):
         except Exception as e:
             logger.exception("decode failed")
             return _response(start_response, http.client.UNSUPPORTED_MEDIA_TYPE, str(e))
+        if deadline is not None:
+            deadline.check("decode")
 
         try:
             model = _hooked_model(service, hooks)
@@ -213,9 +248,21 @@ def make_app(scoring_service=None, hooks=None):
             )
 
         try:
+            # chaos hook: the request-thread predict stage (distinct from
+            # the worker-side batcher.dispatch point) — error drills the 400
+            # path, sleep drills per-stage deadline expiry
+            fault_point("predict.dispatch", content_type=parsed_type)
             if "predict_fn" in hooks:
                 preds = hooks["predict_fn"](dtest, model)
+                if deadline is not None:
+                    # bill a slow user predict_fn to the predict stage, like
+                    # the direct path — not to whatever check runs next
+                    deadline.check("predict")
+            elif deadline is not None:
+                preds = service.predict(dtest, parsed_type, deadline=deadline)
             else:
+                # positional-only call keeps duck-typed services (script-mode
+                # shims, tests) working when no deadline is armed
                 preds = service.predict(dtest, parsed_type)
         except (JobQueueFull, TimeoutError) as e:
             # saturation, not a client error: 503 + Retry-After (MMS parity —
@@ -235,7 +282,17 @@ def make_app(scoring_service=None, hooks=None):
                 http.client.BAD_REQUEST,
                 "Unable to evaluate payload provided: %s" % e,
             )
+        # chaos hook: response encoding (slow/failed serialization of a big
+        # prediction set); the deadline check right after attributes a budget
+        # blown before encoding even starts to the `encode` stage
+        fault_point("serving.encode", accept=accept)
+        if deadline is not None:
+            deadline.check("encode")
         if breaker is not None:
+            # success only once the deadline cleared too: recording it before
+            # the encode check would reset the consecutive-saturation counter
+            # on every request of an encode-stage expiry storm, and the
+            # breaker could never reach its threshold
             breaker.record_success()
 
         if "output_fn" in hooks:
@@ -286,6 +343,14 @@ def make_app(scoring_service=None, hooks=None):
         method = environ.get("REQUEST_METHOD", "GET")
         try:
             if path == "/ping" and method == "GET":
+                if not lifecycle.accepting():
+                    # draining/stopped: unready so the load balancer
+                    # deregisters while in-flight requests finish
+                    return _drain_response(start_response)
+                # each readiness poll publishes the derived ready<->degraded
+                # state (serving_state gauge + serving.lifecycle records);
+                # the shed decision itself stays breaker-driven below
+                lifecycle.observe(breaker)
                 if breaker is not None and breaker.degraded:
                     # flip readiness while shedding: the platform should
                     # stop routing to this instance until it recovers
@@ -299,6 +364,9 @@ def make_app(scoring_service=None, hooks=None):
                     )
                 try:
                     _hooked_model(service, hooks)
+                    # script-mode model_fn loads bypass ScoringService.
+                    # load_model, so readiness is also marked here
+                    lifecycle.mark_ready()
                     return _response(start_response, http.client.OK)
                 except Exception as e:
                     logger.exception("ping model load failed")
@@ -322,6 +390,15 @@ def make_app(scoring_service=None, hooks=None):
             return _response(start_response, http.client.NOT_FOUND, "not found")
         except exc.UserError as e:
             return _response(start_response, http.client.REQUEST_ENTITY_TOO_LARGE, str(e))
+        except DeadlineExceeded as e:
+            # decode/encode-stage expiry surfaces here (the predict-stage
+            # ones ride the TimeoutError clause above): same saturation
+            # protocol — 503 + Retry-After through the breaker feed
+            logger.warning("request deadline exceeded: %s", e)
+            if breaker is not None:
+                breaker.record_saturation()
+                return _shed_response(start_response, breaker, str(e))
+            return _response(start_response, http.client.SERVICE_UNAVAILABLE, str(e))
         except Exception as e:  # last-resort 500
             logger.exception("unhandled serving error")
             return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))

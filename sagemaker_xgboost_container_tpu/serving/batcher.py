@@ -22,6 +22,7 @@ import numpy as np
 from ..models.forest import _host_predict_rows
 from ..telemetry import POW2_BUCKETS, REGISTRY, get_request_id, tracing
 from ..utils.faults import fault_point
+from . import lifecycle
 
 logger = logging.getLogger(__name__)
 
@@ -30,7 +31,7 @@ _LINGER_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.
 
 
 class _Pending:
-    __slots__ = ("features", "event", "result", "error", "ctx")
+    __slots__ = ("features", "event", "result", "error", "ctx", "dispatched")
 
     def __init__(self, features):
         self.features = features
@@ -40,6 +41,10 @@ class _Pending:
         # caller's trace context (SM_TRACE): carried across the queue so the
         # worker's dispatch span joins the request's trace tree
         self.ctx = tracing.current_context()
+        # set by the worker when the batch holding this request starts its
+        # dispatch: a deadline expiring after that is a `predict`-stage
+        # expiry, before it a `queue`-stage one
+        self.dispatched = False
 
 
 class JobQueueFull(Exception):
@@ -139,10 +144,38 @@ class PredictBatcher:
         self._queue = queue.Queue(maxsize=self.max_queue or 0)
         self._carry = None  # width-mismatched request deferred to next batch
         self._exec_lock = threading.Lock()  # held around every predict_fn run
+        # current-dispatch bookkeeping for the predict watchdog
+        # (lifecycle.PredictWatchdog): started timestamp + (requests, rows)
+        # of the batch inside predict_fn right now, None/zeros when idle
+        self._dispatch_lock = threading.Lock()
+        self._dispatch_started = None
+        self._dispatch_meta = (0, 0)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
-    def predict(self, features, timeout=60.0):
+    def dispatch_age_s(self):
+        """Seconds the in-flight predict_fn run has been executing, or None
+        when no dispatch is in flight (the predict-watchdog probe)."""
+        with self._dispatch_lock:
+            started = self._dispatch_started
+        return None if started is None else time.monotonic() - started
+
+    def dispatch_info(self):
+        """-> (requests, rows) of the in-flight dispatch (0, 0 when idle)."""
+        with self._dispatch_lock:
+            return self._dispatch_meta
+
+    def _dispatch_begin(self, requests, rows):
+        with self._dispatch_lock:
+            self._dispatch_started = time.monotonic()
+            self._dispatch_meta = (requests, rows)
+
+    def _dispatch_end(self):
+        with self._dispatch_lock:
+            self._dispatch_started = None
+            self._dispatch_meta = (0, 0)
+
+    def predict(self, features, timeout=60.0, deadline=None):
         feats = np.asarray(features, np.float32)
         # Idle fast path: nothing queued and the worker is not mid-batch ->
         # run predict_fn on the caller's thread, skipping the cross-thread
@@ -161,15 +194,25 @@ class PredictBatcher:
         ):
             try:
                 if self._queue.empty() and self._carry is None:
+                    if deadline is not None:
+                        deadline.check("predict")
                     self._m_requests.inc()
                     self._m_inline.inc()
                     with tracing.trace_span(
                         "batcher.inline",
                         attributes={"rows": int(feats.shape[0])},
                     ):
-                        return np.asarray(self.predict_fn(feats))
+                        self._dispatch_begin(1, int(feats.shape[0]))
+                        try:
+                            return np.asarray(self.predict_fn(feats))
+                        finally:
+                            self._dispatch_end()
             finally:
                 self._exec_lock.release()
+        if deadline is not None:
+            # a request whose budget is already gone must not take a queue
+            # slot another request could use
+            deadline.check("queue")
         pending = _Pending(feats)
         # the queue span covers enqueue -> (result | rejection | timeout) on
         # the caller's thread; the worker's dispatch span is its cross-thread
@@ -197,7 +240,22 @@ class PredictBatcher:
                 )
             self._m_requests.inc()
             self._m_queue_depth.set(self._queue.qsize())
-            if not pending.event.wait(timeout):
+            # SM_REQUEST_DEADLINE_S bounds queue wait PLUS dispatch: the
+            # caller never blocks past the smaller of its legacy timeout and
+            # the remaining request budget
+            wait_s = timeout
+            if deadline is not None:
+                wait_s = min(timeout, deadline.remaining())
+            if not pending.event.wait(wait_s):
+                if deadline is not None and deadline.expired():
+                    # same zombie accounting as the legacy timeout (the
+                    # worker may still dispatch the abandoned rows), but
+                    # attributed to the stage the budget died in
+                    self._m_timeouts.inc()
+                    lifecycle.expire(
+                        "predict" if pending.dispatched else "queue",
+                        deadline.budget_s,
+                    )
                 # zombie pending: this caller gives up, but the worker still
                 # holds the _Pending and may dispatch its rows later — wasted
                 # compute that a timeout storm multiplies. Count every one;
@@ -302,6 +360,11 @@ class PredictBatcher:
                         "rows": sum(p.features.shape[0] for p in batch),
                     },
                 ):
+                    self._dispatch_begin(
+                        len(batch), sum(p.features.shape[0] for p in batch)
+                    )
+                    for pending in batch:
+                        pending.dispatched = True
                     try:
                         # chaos hook: a sleep here wedges the dispatch worker
                         # (tunneled-TPU stall), backing the queue up into
@@ -325,3 +388,5 @@ class PredictBatcher:
                         for pending in batch:
                             pending.error = e
                             pending.event.set()
+                    finally:
+                        self._dispatch_end()

@@ -180,6 +180,23 @@ class CircuitBreaker:
             self._probe_at = now
             return True
 
+    def force_open(self, reason="forced"):
+        """Trip the breaker open right now (predict watchdog: a wedged
+        dispatch must shed and flip /ping without waiting for threshold
+        saturation events). Re-forcing while already open restarts the
+        cooldown, so the breaker stays open for as long as the caller keeps
+        seeing the problem; recovery then rides the normal half-open probe.
+        """
+        if not self.enabled:
+            return
+        with self._lock:
+            already_open = self._state == OPEN
+            self._opened_at = self._clock()
+            self._probe_out = False
+            self._transition(OPEN)
+        if not already_open:
+            logger.warning("circuit breaker forced OPEN: %s", reason)
+
     def record_saturation(self):
         """One saturation event (JobQueueFull or a batch-queue timeout)."""
         if not self.enabled:

@@ -35,11 +35,13 @@ import logging
 import os
 import threading
 
-from . import serve_utils
+from . import lifecycle, serve_utils
 from ..toolkit import exceptions as exc
 from ..utils.envconfig import env_int
-from .app import _read_body, _response, parse_accept
+from ..utils.faults import fault_point
+from .app import _drain_response, _read_body, _response, _shed_response, parse_accept
 from .batcher import JobQueueFull, PredictBatcher
+from .lifecycle import DeadlineExceeded
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +76,13 @@ class ModelManager:
         self._models = collections.OrderedDict()  # name -> (model, fmt, dir, batcher)
         self._lock = threading.Lock()
         self.max_models = max_models or int(os.getenv("SAGEMAKER_MAX_MODELS", "0")) or None
+        # manager-level breaker: MME has no per-model /ping, so degradation
+        # (sustained saturation, a stuck predict dispatch) is endpoint-wide —
+        # exactly the MMS frontend's behavior. Rides the existing
+        # SM_LOAD_SHEDDING gate; with it off, saturation stays per-request.
+        from .breaker import CircuitBreaker
+
+        self.breaker = CircuitBreaker(name="mme")
 
     def load(self, name, url):
         model_dir = url
@@ -104,6 +113,7 @@ class ModelManager:
             if self.max_models and len(self._models) > self.max_models:
                 evicted, _ = self._models.popitem(last=False)
                 _drop_batcher_metrics(evicted)
+                lifecycle.unregister_batcher(evicted)
                 logger.info("Evicted model %s (LRU cap %d)", evicted, self.max_models)
             # compile the first device buckets off the request path — only
             # for a model that survived registration AND the LRU eviction
@@ -112,6 +122,12 @@ class ModelManager:
             # it in between
             if name in self._models:
                 serve_utils.warmup_predict_async(model)
+                if batcher is not None:
+                    # predict watchdog: a wedged dispatch on ANY model's
+                    # batcher wedges the whole single-TPU process, so it
+                    # trips the endpoint-wide breaker; registered only for a
+                    # model that survived insertion + LRU eviction
+                    lifecycle.register_batcher(name, batcher, self.breaker)
 
     def unload(self, name):
         with self._lock:
@@ -119,6 +135,7 @@ class ModelManager:
                 raise KeyError(name)
             del self._models[name]
             _drop_batcher_metrics(name)
+            lifecycle.unregister_batcher(name)
 
     def get(self, name):
         with self._lock:
@@ -143,6 +160,21 @@ def make_mme_app(manager=None):
         method = environ.get("REQUEST_METHOD", "GET")
         try:
             if path == "/ping" and method == "GET":
+                if not lifecycle.accepting():
+                    # draining/stopped: deregister while in-flight invokes
+                    # settle (docs/robustness.md §Serving lifecycle)
+                    return _drain_response(start_response)
+                # publish derived ready<->degraded on every readiness poll
+                lifecycle.observe(manager.breaker)
+                if manager.breaker.degraded:
+                    return _response(
+                        start_response,
+                        http.client.SERVICE_UNAVAILABLE,
+                        "degraded: shedding load",
+                        extra_headers=[
+                            ("Retry-After", str(manager.breaker.retry_after_s()))
+                        ],
+                    )
                 return _response(start_response, http.client.OK, json.dumps({"status": "Healthy"}), "application/json")
 
             if path == "/models" and method == "GET":
@@ -213,6 +245,12 @@ def make_mme_app(manager=None):
                     )
                 return _invoke(manager, models[0]["modelName"], environ, start_response)
             return _response(start_response, http.client.NOT_FOUND, "not found")
+        except DeadlineExceeded as e:
+            # decode/encode-stage expiry (the predict-stage ones are handled
+            # inside _invoke): saturation protocol, not a client error
+            logger.warning("request deadline exceeded: %s", e)
+            manager.breaker.record_saturation()
+            return _shed_response(start_response, manager.breaker, str(e))
         except Exception as e:
             logger.exception("unhandled MME error")
             return _response(start_response, http.client.INTERNAL_SERVER_ERROR, str(e))
@@ -230,6 +268,13 @@ def _query_params(environ):
 
 
 def _invoke(manager, name, environ, start_response):
+    if not lifecycle.accepting():
+        return _drain_response(start_response)
+    if not manager.breaker.allow():
+        # open breaker (sustained saturation or a stuck predict dispatch):
+        # shed before decode, endpoint-wide — the MMS frontend analog
+        return _shed_response(start_response, manager.breaker, "shedding load")
+    deadline = lifecycle.request_deadline()
     try:
         model, fmt, _dir, batcher = manager.get(name)
     except KeyError:
@@ -245,35 +290,45 @@ def _invoke(manager, name, environ, start_response):
         dtest, parsed_type = serve_utils.parse_content_data(payload, content_type)
     except Exception as e:
         return _response(start_response, http.client.UNSUPPORTED_MEDIA_TYPE, str(e))
+    if deadline is not None:
+        deadline.check("decode")
     try:
         accept = parse_accept(environ)
     except ValueError as e:
         return _response(start_response, http.client.NOT_ACCEPTABLE, str(e))
     try:
+        fault_point("predict.dispatch", model=name, content_type=parsed_type)
         first = model[0] if isinstance(model, list) else model
         if batcher is not None:
             from ..data.content_types import get_content_type
 
             serve_utils._check_feature_count(first, dtest, get_content_type(parsed_type))
-            preds = batcher.predict(serve_utils.canonicalize_features(first, dtest))
+            preds = batcher.predict(
+                serve_utils.canonicalize_features(first, dtest), deadline=deadline
+            )
         else:
             preds = serve_utils.predict(
                 model, fmt, dtest, parsed_type, objective=first.objective_name
             )
+            if deadline is not None:
+                deadline.check("predict")
     except (JobQueueFull, TimeoutError) as e:
-        # saturation: 503 with a Retry-After hint (same shed contract as the
-        # single-model app; the per-model queue bound is the MMS analog)
-        from .breaker import retry_after_hint
-
-        return _response(
-            start_response,
-            http.client.SERVICE_UNAVAILABLE,
-            str(e),
-            extra_headers=[("Retry-After", str(retry_after_hint()))],
-        )
+        # saturation (incl. per-stage deadline expiry): 503 + Retry-After,
+        # feeding the endpoint-wide breaker so a sustained storm flips
+        # /ping and sheds pre-decode (same shed contract as the single-model
+        # app; the per-model queue bound is the MMS analog)
+        manager.breaker.record_saturation()
+        return _shed_response(start_response, manager.breaker, str(e))
     except Exception as e:
         logger.exception("invoke predict failed")
         return _response(start_response, http.client.BAD_REQUEST, str(e))
+    fault_point("serving.encode", model=name, accept=accept)
+    if deadline is not None:
+        deadline.check("encode")
+    # success only after the deadline cleared: recording it before the
+    # encode check would reset the saturation counter every request and an
+    # encode-expiry storm could never open the breaker
+    manager.breaker.record_success()
     import numpy as np
 
     preds_list = np.asarray(preds).tolist()

@@ -89,6 +89,9 @@ VALID_OBJECTIVES = {
 
 def parse_content_data(input_data, input_content_type):
     """Request body + content type -> (DataMatrix, canonical content type)."""
+    # chaos hook: payload decode (both serving apps funnel through here) —
+    # error drills the 415 path, sleep drills the decode-stage deadline
+    fault_point("serving.decode", content_type=input_content_type)
     content_type = get_content_type(input_content_type)
     payload = input_data
     if content_type == CSV:

@@ -14,19 +14,23 @@ compute and worker-per-copy is unnecessary). Dispatch:
 host-side numpy work doesn't oversubscribe the VM.
 """
 
+import hashlib
 import importlib.util
 import logging
 import os
 import signal
 import sys
 import threading
+import time
 from socketserver import ThreadingMixIn
 from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from .. import constants
 from .. import telemetry
+from ..constants import EXIT_DRAIN_TIMEOUT
 from ..utils.envconfig import env_float
 from ..utils.logging_config import setup_main_logger
+from . import lifecycle as lifecycle_mod
 from .app import ScoringService, make_app
 from .mme import make_mme_app
 
@@ -60,7 +64,17 @@ def is_multi_model():
 
 
 def _load_user_hooks(model_dir):
-    """Import the customer's inference script if present; return hook dict."""
+    """Import the customer's inference script if present; return hook dict.
+
+    Import hygiene: the script dir lands on ``sys.path`` (user scripts
+    import sibling helpers, lazily too — so a successful load keeps it,
+    without duplicating an entry already there), and the module registers
+    in ``sys.modules`` under a name derived from the script path (pickle /
+    dataclass machinery resolves classes through it; a fixed name would
+    alias distinct scripts). A FAILED exec rolls both back, so a broken
+    script can't poison a retried load with a half-initialized module or a
+    stale path entry.
+    """
     program = os.environ.get("SAGEMAKER_PROGRAM")
     candidates = []
     if program:
@@ -77,10 +91,26 @@ def _load_user_hooks(model_dir):
     from ..utils.requirements import install_requirements_if_present
 
     install_requirements_if_present(os.path.dirname(script))
-    spec = importlib.util.spec_from_file_location("user_inference_module", script)
+    script_dir = os.path.dirname(script)
+    module_name = "user_inference_{}".format(
+        hashlib.sha1(os.path.abspath(script).encode("utf-8")).hexdigest()[:12]
+    )
+    spec = importlib.util.spec_from_file_location(module_name, script)
     module = importlib.util.module_from_spec(spec)
-    sys.path.insert(0, os.path.dirname(script))
-    spec.loader.exec_module(module)
+    inserted = script_dir not in sys.path
+    if inserted:
+        sys.path.insert(0, script_dir)
+    sys.modules[module_name] = module
+    try:
+        spec.loader.exec_module(module)
+    except BaseException:
+        sys.modules.pop(module_name, None)
+        if inserted:
+            try:
+                sys.path.remove(script_dir)
+            except ValueError:
+                pass
+        raise
     hooks = {name: getattr(module, name) for name in HOOK_NAMES if hasattr(module, name)}
     logger.info("Loaded user serving hooks from %s: %s", script, sorted(hooks))
     return hooks
@@ -89,7 +119,11 @@ def _load_user_hooks(model_dir):
 def build_app():
     if is_multi_model():
         logger.info("Starting multi-model endpoint manager")
-        return make_mme_app()
+        app = make_mme_app()
+        # MME starts empty by design (models arrive via POST /models):
+        # there is no warmup to gate readiness on
+        lifecycle_mod.mark_ready()
+        return app
     model_dir = os.getenv(constants.SM_MODEL_DIR, "/opt/ml/model")
     hooks = _load_user_hooks(model_dir)
     return make_app(ScoringService(model_dir), hooks=hooks)
@@ -145,6 +179,68 @@ def start_metrics_reporter(interval=None, registry=None):
     return reporter
 
 
+def drain_and_shutdown(httpd, lifecycle, reporter=None):
+    """Settle in-flight work, then close the listener. The SIGTERM contract:
+
+    1. ``begin_drain()`` — /ping answers 503 + Retry-After so the load
+       balancer deregisters, /invocations refuses new work the same way.
+       The listener stays OPEN: a connect that raced the drain gets an
+       orderly 503, never a RST.
+    2. In-flight requests (WSGI latch: response bodies fully written) get
+       ``SM_DRAIN_TIMEOUT_S`` to finish.
+    3. Drained -> stop the accept loop, close the listener, exit 0.
+       Still-wedged requests past the deadline -> flight-recorder dump +
+       one ``serving.abort`` record and a distinct exit code (83) so the
+       platform log names the failure instead of a mystery SIGKILL.
+
+    Legacy mode (``SM_GRACEFUL_DRAIN=false``) skips the wait but still
+    shuts the server down in an orderly fashion (no ``SystemExit`` from a
+    signal handler; daemon request threads die with the process, exactly
+    the pre-drain behavior).
+
+    Shared by the SIGTERM handler, the serve drill, and bench_serve's churn
+    leg. Returns True on a clean drain (False only from the test hook's
+    fake exit).
+    """
+    drain_start = time.monotonic()
+    if lifecycle is not None and lifecycle.graceful_drain:
+        lifecycle.begin_drain()
+        drained = lifecycle.wait_drained(lifecycle.drain_timeout_s)
+        lifecycle.observe_drain_seconds(time.monotonic() - drain_start)
+        if not drained:
+            logger.error(
+                "drain timed out after %.1fs with %d request(s) still in "
+                "flight — wedged predict; exiting %d for a clean restart",
+                lifecycle.drain_timeout_s, lifecycle.inflight, EXIT_DRAIN_TIMEOUT,
+            )
+            if reporter is not None:
+                reporter.stop(timeout=2.0)
+            from .lifecycle import abort_serving
+
+            abort_serving(
+                "drain_timeout",
+                EXIT_DRAIN_TIMEOUT,
+                inflight=lifecycle.inflight,
+                drain_timeout_s=lifecycle.drain_timeout_s,
+            )
+            return False  # only reachable when the exit hook is faked
+        logger.info(
+            "drain complete in %.2fs; closing the listener",
+            time.monotonic() - drain_start,
+        )
+    elif lifecycle is not None:
+        lifecycle.begin_drain()  # still flip /ping for the shutdown window
+        logger.info("graceful drain disabled (%s=false): immediate shutdown",
+                    lifecycle_mod.GRACEFUL_DRAIN_ENV)
+    if reporter is not None:
+        reporter.stop(timeout=2.0)
+    httpd.shutdown()
+    httpd.server_close()
+    if lifecycle is not None:
+        lifecycle.mark_stopped()
+    return True
+
+
 def serving_entrypoint(port=None, block=True):
     set_default_serving_env_if_unspecified()
     setup_main_logger(__name__)
@@ -152,6 +248,10 @@ def serving_entrypoint(port=None, block=True):
     # device-runtime gauges (XLA compile count/seconds, RSS, live device
     # bytes) feed /metrics and the snapshot records from serving startup on
     telemetry.register_runtime_gauges()
+    # lifecycle state machine + in-flight latch + (env-gated) predict
+    # watchdog; knobs resolve once here (docs/robustness.md §Serving
+    # lifecycle)
+    lifecycle = lifecycle_mod.install(lifecycle_mod.ServingLifecycle())
     app = build_app()
     logger.info(
         "GET /metrics is %s (gate: %s=true)",
@@ -163,16 +263,36 @@ def serving_entrypoint(port=None, block=True):
         "0.0.0.0", port, app, server_class=_ThreadedWSGIServer, handler_class=_QuietHandler
     )
 
+    shutdown_state = {"thread": None}
+    shutdown_lock = threading.Lock()
+
     def _shutdown(signo, frame):
-        logger.info("Received signal %s, shutting down", signo)
-        if reporter is not None:
-            reporter.stop(timeout=2.0)
-        raise SystemExit(0)
+        # The handler runs ON the main thread, which is blocked inside
+        # serve_forever: both the drain wait and httpd.shutdown() (which
+        # blocks until the serve loop acknowledges) would deadlock here.
+        # Hand the whole sequence to a supervisor thread and return, letting
+        # serve_forever keep answering 503s until the drain settles.
+        logger.info("Received signal %s, draining before shutdown", signo)
+        with shutdown_lock:
+            if shutdown_state["thread"] is not None:
+                return  # duplicate SIGTERM while already draining
+            shutdown_state["thread"] = threading.Thread(
+                target=drain_and_shutdown,
+                args=(httpd, lifecycle),
+                kwargs={"reporter": reporter},
+                daemon=True,
+                name="serving-drain",
+            )
+            shutdown_state["thread"].start()
 
     signal.signal(signal.SIGTERM, _shutdown)
     logger.info("Serving on port %d", port)
     if block:
         httpd.serve_forever()
+        with shutdown_lock:
+            drainer = shutdown_state["thread"]
+        if drainer is not None:
+            drainer.join(timeout=lifecycle.drain_timeout_s + 10.0)
     return httpd
 
 

@@ -33,6 +33,43 @@ from .registry import REGISTRY
 
 METRICS_ENDPOINT_ENV = "SM_SERVING_METRICS"
 
+# The serving lifecycle's in-flight latch (serving/lifecycle.py) registers
+# itself here — a generic hook so telemetry never imports the serving layer.
+# A request is "finished" only when its response body has been fully written
+# (the WSGI server calls the result iterable's close() after the write loop),
+# which is exactly what a graceful drain must wait for: a process that exits
+# after the app returned but before the body flushed still truncates the
+# response.
+_request_tracker = None
+
+
+def set_request_tracker(tracker):
+    """Install/clear the in-flight tracker (``request_started()`` /
+    ``request_finished()``). None disables tracking."""
+    global _request_tracker
+    _request_tracker = tracker
+
+
+class _TrackedBody:
+    """Wrap a WSGI result so ``request_finished`` fires exactly once, after
+    the server has written (or abandoned) the whole response body."""
+
+    def __init__(self, result, on_close):
+        self._result = result
+        self._on_close = on_close
+
+    def __iter__(self):
+        return iter(self._result)
+
+    def close(self):
+        try:
+            close = getattr(self._result, "close", None)
+            if close is not None:
+                close()
+        finally:
+            self._on_close()
+
+
 _KNOWN_ROUTES = ("/ping", "/invocations", "/execution-parameters", "/metrics")
 
 # 1KB .. 8MB payload buckets (MAX_CONTENT_LENGTH default is 6MB)
@@ -108,6 +145,26 @@ def instrument_wsgi(app, registry=None):
         method = environ.get("REQUEST_METHOD", "GET")
         route = _route_label(path)
 
+        # in-flight latch: started here, finished when the response body has
+        # been fully written (result close) or the app died — the drain in
+        # serving/lifecycle.py waits on exactly this count. Requests arriving
+        # once the tracker stopped accepting (draining/stopped) are NOT
+        # latched: they only ever get the fast 503, and counting them would
+        # let sustained LB health-checks/retries hold the drain open past
+        # its deadline and turn a healthy shutdown into an exit-83 abort.
+        tracker = _request_tracker
+        if tracker is not None and not getattr(tracker, "accepting", True):
+            tracker = None
+        finished = []
+
+        def _finish():
+            if tracker is not None and not finished:
+                finished.append(True)
+                tracker.request_finished()
+
+        if tracker is not None:
+            tracker.request_started()
+
         if path == "/metrics" and method == "GET":
             if not metrics_endpoint_enabled():
                 # indistinguishable from any other unknown route when gated
@@ -117,16 +174,20 @@ def instrument_wsgi(app, registry=None):
                     [("Content-Type", "text/plain"),
                      ("Content-Length", str(len(body)))],
                 )
-                return [body]
-            from .cluster import refresh_runtime_gauges
-            from .prometheus import exposition_response
+                return _TrackedBody([body], _finish)
+            try:
+                from .cluster import refresh_runtime_gauges
+                from .prometheus import exposition_response
 
-            status, resp_headers, body = exposition_response(
-                reg, refresh_runtime_gauges
-            )
-            start_response(status, resp_headers)
-            _counter(route, "2xx").inc()
-            return [body]
+                status, resp_headers, body = exposition_response(
+                    reg, refresh_runtime_gauges
+                )
+                start_response(status, resp_headers)
+                _counter(route, "2xx").inc()
+            except Exception:
+                _finish()
+                raise
+            return _TrackedBody([body], _finish)
 
         captured = {}
         request_id = extract_request_id(environ)
@@ -163,6 +224,7 @@ def instrument_wsgi(app, registry=None):
             result = app(environ, recording_start_response)
         except Exception:
             _counter(route, "5xx").inc()
+            _finish()
             raise
         finally:
             if tspan is not None:
@@ -177,7 +239,7 @@ def instrument_wsgi(app, registry=None):
         _latency(route).observe(elapsed)
         if length:
             _payload(route).observe(length)
-        return result
+        return _TrackedBody(result, _finish) if tracker is not None else result
 
     return wrapped
 
@@ -185,5 +247,6 @@ def instrument_wsgi(app, registry=None):
 __all__ = [
     "instrument_wsgi",
     "metrics_endpoint_enabled",
+    "set_request_tracker",
     "METRICS_ENDPOINT_ENV",
 ]

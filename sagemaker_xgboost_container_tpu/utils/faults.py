@@ -20,6 +20,12 @@ Spec grammar (entries separated by ``;`` or ``,``)::
     sync.accept:drop              raises ConnectionError (socket drop)
     batcher.dispatch:exit:9       hard-exits the process (host death)
     training.round_end:kill@4     4th round SIGKILLs this process (dead host)
+    batcher.dispatch:sleep:120@2  wedges the 2nd predict dispatch (the
+                                  stuck-predict watchdog drill)
+    serving.decode:error:bad      every payload decode 415s
+    predict.dispatch:sleep:5      request-thread predict stalls (deadline
+                                  drills); serving.encode is its twin on
+                                  the response side
 
 Actions: ``error[:msg]`` -> OSError, ``drop`` -> ConnectionError,
 ``sleep:<seconds>``, ``sigterm`` (os.kill SIGTERM), ``exit:<code>``

@@ -74,6 +74,17 @@ case "$TIER" in
         echo "CI $TIER TIER FAILED (elastic drill; see $ARTIFACT_DIR/elastic)"
       fi
     fi
+    if [ $rc -eq 0 ]; then
+      # serving lifecycle drills: SIGTERM drain mid-flight, wedged-predict
+      # watchdog (shed + abort), archiving server logs + flight recorders
+      if PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$REPO/scripts/serve_drill.py" "$ARTIFACT_DIR/serve"; then
+        echo "serve drill: OK (artifacts: $ARTIFACT_DIR/serve)"
+      else
+        rc=1
+        echo "CI $TIER TIER FAILED (serve drill; see $ARTIFACT_DIR/serve)"
+      fi
+    fi
     # the case arm's status feeds the shared rc=$? below
     (exit $rc)
     ;;

@@ -1,0 +1,447 @@
+#!/usr/bin/env python
+"""Serving lifecycle chaos drill: SIGTERM mid-flight + wedged-predict paths.
+
+Self-spawning harness (parent mode spawns a real server child running this
+same file) exercising the serving lifecycle plane end to end over real HTTP:
+
+* ``--mode drain`` — graceful drain: the child serves a trained model with
+  every batcher dispatch slowed (``batcher.dispatch:sleep``); the parent
+  launches concurrent clients and SIGTERMs the server while their requests
+  are in flight. Asserts: **zero dropped in-flight responses** (every
+  accepted request completes 200 with a full, parseable body), new connects
+  during the drain get **503 + Retry-After** (both ``/invocations`` and
+  ``/ping``), the stdout lifecycle records walk ``draining → stopped``, and
+  the child exits **0**.
+* ``--mode stuck`` — wedged-predict watchdog (shed action): the 2nd
+  dispatch wedges (``batcher.dispatch:sleep:300@2``); the watchdog
+  (``SM_PREDICT_STUCK_S``) trips the breaker open (``/ping`` 503, new
+  requests shed with Retry-After), emits one ``serving.stuck`` record, and
+  leaves a flight-recorder dump. A SIGTERM then cannot drain the wedged
+  request, so the child exits **83** (``EXIT_DRAIN_TIMEOUT``) with a
+  ``serving.abort`` record — never a silent hang.
+* ``--mode abort`` — the same wedge with ``SM_PREDICT_STUCK_ACTION=abort``:
+  the watchdog itself aborts the process with **84**
+  (``EXIT_PREDICT_STUCK``) so the platform restarts a clean device runtime.
+
+Artifacts (child stdout, flight-recorder dumps) are archived under the
+given directory — CI wires this into the chaos tier with
+``${CI_ARTIFACT_DIR:-.ci-artifacts}/serve/``.
+
+Exit code: 0 when every assertion holds, 1 otherwise (2 on usage errors).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_CLIENTS = 6
+ROWS = 8
+FEATURES = 8
+
+
+def _free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------- server child
+def child_main(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from sagemaker_xgboost_container_tpu.serving.server import serving_entrypoint
+
+    serving_entrypoint(port=args.port)
+    return 0
+
+
+# ------------------------------------------------------------------- clients
+def _post(base, body, timeout=30):
+    req = urllib.request.Request(
+        base + "/invocations",
+        data=body,
+        method="POST",
+        headers={"Content-Type": "text/csv"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(base, path, timeout=10):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _csv_payload(rows=ROWS):
+    return (
+        "\n".join(",".join("0.5" for _ in range(FEATURES)) for _ in range(rows))
+    ).encode()
+
+
+def _wait_ready(base, deadline_s=120):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            status, _, _ = _get(base, "/ping", timeout=5)
+            if status == 200:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+def _valid_body(body, rows=ROWS):
+    lines = [l for l in body.decode("utf-8").strip().splitlines() if l]
+    if len(lines) != rows:
+        return False
+    try:
+        for line in lines:
+            for cell in line.split(","):
+                float(cell)
+    except ValueError:
+        return False
+    return True
+
+
+# -------------------------------------------------------------------- parent
+def _train_model(model_dir):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, FEATURES).astype(np.float32)
+    y = (X @ rng.rand(FEATURES).astype(np.float32)).astype(np.float32)
+    forest = train(
+        {"max_depth": 3, "objective": "reg:squarederror"},
+        DataMatrix(X, labels=y),
+        num_boost_round=8,
+    )
+    os.makedirs(model_dir, exist_ok=True)
+    forest.save_model(os.path.join(model_dir, "xgboost-model"))
+
+
+def _spawn(mode, workdir, model_dir, port):
+    env = dict(os.environ)
+    for stale in ("SM_FAULT_SPEC", "SM_TRACE", "SM_PREDICT_STUCK_S",
+                  "SM_PREDICT_STUCK_ACTION", "SM_REQUEST_DEADLINE_S",
+                  "SM_DRAIN_TIMEOUT_S", "SM_GRACEFUL_DRAIN"):
+        env.pop(stale, None)
+    trace_dir = os.path.join(workdir, "trace")
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO,
+            "PYTHONUNBUFFERED": "1",
+            "SM_MODEL_DIR": model_dir,
+            # every request takes the coalescing queue (and therefore the
+            # faultable worker dispatch) — the host fast path would dodge
+            # the chaos hooks
+            "GRAFT_HOST_PREDICT_ROWS": "0",
+            # warmup compiles would blur drill timing on a cold CPU backend
+            "GRAFT_PREDICT_WARMUP": "0",
+        }
+    )
+    if mode == "drain":
+        # slow every dispatch enough that SIGTERM lands mid-flight but a
+        # few batches still settle well inside the drain deadline
+        env["SM_FAULT_SPEC"] = "batcher.dispatch:sleep:1.5"
+        env["SM_DRAIN_TIMEOUT_S"] = "60"
+    else:
+        # first dispatch clean (proves the path), second wedges far past
+        # every deadline in play
+        env["SM_FAULT_SPEC"] = "batcher.dispatch:sleep:300@2"
+        env["SM_PREDICT_STUCK_S"] = "1"
+        env["SM_TRACE"] = "1"
+        env["SM_TRACE_EXPORT_DIR"] = trace_dir
+        env["SM_DRAIN_TIMEOUT_S"] = "3"
+        if mode == "abort":
+            env["SM_PREDICT_STUCK_ACTION"] = "abort"
+    out = open(os.path.join(workdir, "server.out"), "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--child",
+            "--port", str(port),
+        ],
+        env=env,
+        stdout=out,
+        stderr=subprocess.STDOUT,
+    )
+    return proc, out
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def _records(text, metric):
+    prefix = '{{"metric": "{}"'.format(metric)
+    return [json.loads(l) for l in text.splitlines() if l.startswith(prefix)]
+
+
+def _check(ok, message, failures):
+    print(("ok: " if ok else "FAIL: ") + message, flush=True)
+    if not ok:
+        failures.append(message)
+    return ok
+
+
+def _wait_exit(proc, out, timeout=120):
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+    out.close()
+    return proc.returncode
+
+
+def _run_drain(workdir, model_dir, failures):
+    port = _free_port()
+    base = "http://127.0.0.1:{}".format(port)
+    proc, out = _spawn("drain", workdir, model_dir, port)
+    try:
+        if not _check(_wait_ready(base), "server became ready", failures):
+            return
+        payload = _csv_payload()
+        results = []
+
+        def client():
+            try:
+                results.append(_post(base, payload, timeout=90))
+            except Exception as e:  # dropped mid-flight = the bug we drill
+                results.append(("EXC", repr(e), {}))
+
+        threads = [
+            threading.Thread(target=client, daemon=True) for _ in range(N_CLIENTS)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.7)  # first dispatch mid-sleep, the rest queued
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(0.3)  # let begin_drain land
+
+        # new work during the drain: orderly 503 + Retry-After, never a RST
+        status, _, headers = _post(base, payload, timeout=10)
+        _check(
+            status == 503 and headers.get("Retry-After"),
+            "new /invocations during drain got 503 + Retry-After "
+            "(got {} {})".format(status, headers.get("Retry-After")),
+            failures,
+        )
+        ping_status, _, ping_headers = _get(base, "/ping")
+        _check(
+            ping_status == 503 and ping_headers.get("Retry-After"),
+            "/ping during drain got 503 + Retry-After (got {})".format(ping_status),
+            failures,
+        )
+
+        for t in threads:
+            t.join(timeout=120)
+        ok = [r for r in results if r[0] == 200 and _valid_body(r[1])]
+        _check(
+            len(results) == N_CLIENTS and len(ok) == N_CLIENTS,
+            "all {} in-flight requests completed with valid bodies "
+            "({} ok, results: {})".format(
+                N_CLIENTS, len(ok), [r[0] for r in results]
+            ),
+            failures,
+        )
+        rc = _wait_exit(proc, out)
+        _check(rc == 0, "server drained and exited 0 (rc={})".format(rc), failures)
+        text = _read(os.path.join(workdir, "server.out"))
+        states = [r["state"] for r in _records(text, "serving.lifecycle")]
+        _check(
+            "draining" in states and "stopped" in states,
+            "lifecycle records walk draining -> stopped ({})".format(states),
+            failures,
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if not out.closed:
+            out.close()
+
+
+def _run_stuck(workdir, model_dir, failures, abort=False):
+    port = _free_port()
+    base = "http://127.0.0.1:{}".format(port)
+    proc, out = _spawn("abort" if abort else "stuck", workdir, model_dir, port)
+    try:
+        if not _check(_wait_ready(base), "server became ready", failures):
+            return
+        payload = _csv_payload()
+        status, body, _ = _post(base, payload)
+        _check(
+            status == 200 and _valid_body(body),
+            "first request (clean dispatch) returned 200 (got {})".format(status),
+            failures,
+        )
+
+        # the wedge: its client gives up quickly; the dispatch stays stuck
+        def wedged():
+            try:
+                _post(base, payload, timeout=4)
+            except Exception:
+                pass
+
+        threading.Thread(target=wedged, daemon=True).start()
+
+        if abort:
+            rc = _wait_exit(proc, out, timeout=60)
+            _check(
+                rc == 84,
+                "watchdog abort action exited EXIT_PREDICT_STUCK "
+                "(rc={}, want 84)".format(rc),
+                failures,
+            )
+        else:
+            # shed action: breaker open -> /ping 503 + new requests shed
+            deadline = time.monotonic() + 30
+            ping_status = None
+            while time.monotonic() < deadline:
+                ping_status, _, _ = _get(base, "/ping")
+                if ping_status == 503:
+                    break
+                time.sleep(0.25)
+            _check(
+                ping_status == 503,
+                "watchdog tripped the breaker: /ping 503 while stuck "
+                "(got {})".format(ping_status),
+                failures,
+            )
+            status, _, headers = _post(base, payload, timeout=10)
+            _check(
+                status == 503 and headers.get("Retry-After"),
+                "stuck endpoint sheds with 503 + Retry-After (got {})".format(status),
+                failures,
+            )
+            # SIGTERM now: the wedged request can never drain -> exit 83
+            proc.send_signal(signal.SIGTERM)
+            rc = _wait_exit(proc, out, timeout=60)
+            _check(
+                rc == 83,
+                "drain with a wedged request exited EXIT_DRAIN_TIMEOUT "
+                "(rc={}, want 83)".format(rc),
+                failures,
+            )
+
+        text = _read(os.path.join(workdir, "server.out"))
+        stuck = _records(text, "serving.stuck")
+        _check(
+            len(stuck) == 1 and stuck[0].get("stuck_s", 0) >= 1,
+            "exactly one serving.stuck record emitted ({})".format(len(stuck)),
+            failures,
+        )
+        dump = stuck[0].get("flight_recorder") if stuck else None
+        _check(
+            bool(dump) and os.path.exists(dump),
+            "serving.stuck carries a flight-recorder dump ({})".format(dump),
+            failures,
+        )
+        aborts = _records(text, "serving.abort")
+        want_reason = "predict_stuck" if abort else "drain_timeout"
+        want_code = 84 if abort else 83
+        _check(
+            aborts
+            and aborts[0]["reason"] == want_reason
+            and aborts[0]["exit_code"] == want_code,
+            "serving.abort names {}/{} ({})".format(
+                want_reason, want_code,
+                [(a.get("reason"), a.get("exit_code")) for a in aborts],
+            ),
+            failures,
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        if not out.closed:
+            out.close()
+
+
+def _archive(workdir, artifact_dir, mode):
+    dest = os.path.join(artifact_dir, mode)
+    os.makedirs(dest, exist_ok=True)
+    src = os.path.join(workdir, "server.out")
+    if os.path.exists(src):
+        shutil.copy2(src, dest)
+    trace_dir = os.path.join(workdir, "trace")
+    if os.path.isdir(trace_dir):
+        for f in os.listdir(trace_dir):
+            shutil.copy2(os.path.join(trace_dir, f), os.path.join(dest, f))
+    print("artifacts archived under {}".format(dest), flush=True)
+
+
+def parent_main(args):
+    failures = []
+    modes = [args.mode] if args.mode != "all" else ["drain", "stuck", "abort"]
+    artifact_dir = os.path.abspath(args.artifact_dir)
+    os.makedirs(artifact_dir, exist_ok=True)
+    model_dir = tempfile.mkdtemp(prefix="serve-drill-model-")
+    try:
+        _train_model(model_dir)
+        for mode in modes:
+            print("--- serve drill: {} ---".format(mode), flush=True)
+            workdir = tempfile.mkdtemp(prefix="serve-drill-{}-".format(mode))
+            try:
+                if mode == "drain":
+                    _run_drain(workdir, model_dir, failures)
+                else:
+                    _run_stuck(workdir, model_dir, failures, abort=(mode == "abort"))
+                _archive(workdir, artifact_dir, mode)
+            finally:
+                shutil.rmtree(workdir, ignore_errors=True)
+    finally:
+        shutil.rmtree(model_dir, ignore_errors=True)
+    if failures:
+        print("SERVE DRILL FAILED ({} assertion(s))".format(len(failures)), flush=True)
+        return 1
+    print("SERVE DRILL OK", flush=True)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("artifact_dir", nargs="?", default=".ci-artifacts/serve")
+    parser.add_argument(
+        "--mode", choices=["drain", "stuck", "abort", "all"], default="all"
+    )
+    parser.add_argument("--child", action="store_true")
+    parser.add_argument("--port", type=int)
+    args = parser.parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,772 @@
+"""Serving lifecycle plane: state machine, graceful drain, per-stage request
+deadlines, and the wedged-predict watchdog (docs/robustness.md §Serving
+lifecycle).
+
+Marked ``chaos`` (fault-injection drills ride the same harness as the
+training supervision tests), but everything here is laptop-fast: in-process
+WSGI calls plus two real-HTTP drain drills. The end-to-end subprocess
+drills (SIGTERM over a real socket, exit codes 83/84) live in
+``scripts/serve_drill.py``, wired into ``tox -e chaos`` / ``ci.sh chaos``.
+"""
+
+import io
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+from sagemaker_xgboost_container_tpu.serving import lifecycle
+from sagemaker_xgboost_container_tpu.serving.app import ScoringService, make_app
+from sagemaker_xgboost_container_tpu.serving.batcher import PredictBatcher
+from sagemaker_xgboost_container_tpu.serving.breaker import CircuitBreaker
+from sagemaker_xgboost_container_tpu.serving.lifecycle import (
+    DeadlineExceeded,
+    PredictWatchdog,
+    RequestDeadline,
+    ServingLifecycle,
+)
+from sagemaker_xgboost_container_tpu.serving.mme import ModelManager, make_mme_app
+from sagemaker_xgboost_container_tpu.serving.server import drain_and_shutdown
+from sagemaker_xgboost_container_tpu.telemetry.registry import MetricsRegistry
+from sagemaker_xgboost_container_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+N_FEATURES = 4
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, N_FEATURES).astype(np.float32)
+    y = (X @ rng.rand(N_FEATURES).astype(np.float32)).astype(np.float32)
+    forest = train(
+        {"max_depth": 2, "objective": "reg:squarederror"},
+        DataMatrix(X, labels=y),
+        num_boost_round=4,
+    )
+    d = tmp_path_factory.mktemp("lifecycle-model")
+    forest.save_model(str(d / "xgboost-model"))
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    """Every test starts with no installed lifecycle, no armed faults, and
+    warmup off (a background compile thread would blur drain timing)."""
+    monkeypatch.setenv("GRAFT_PREDICT_WARMUP", "0")
+    faults.reset()
+    lifecycle.uninstall()
+    lifecycle._reset_abort_for_tests()
+    yield
+    faults.reset()
+    lifecycle.uninstall()
+    lifecycle._reset_abort_for_tests()
+
+
+def _call(app, method, path, body=b"", content_type="text/csv"):
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "CONTENT_LENGTH": str(len(body)),
+        "CONTENT_TYPE": content_type,
+        "wsgi.input": io.BytesIO(body),
+    }
+    captured = {}
+
+    def start_response(status, headers, exc_info=None):
+        captured["status"] = status
+        captured["headers"] = headers
+
+    result = app(environ, start_response)
+    out = b"".join(result)
+    close = getattr(result, "close", None)
+    if close is not None:
+        close()  # the real WSGI server does this after the write loop
+    status = int(captured["status"].split()[0])
+    headers = {k.lower(): v for k, v in captured["headers"]}
+    return status, headers, out
+
+
+def _csv_rows(n):
+    return ("\n".join(",".join("0.5" for _ in range(N_FEATURES)) for _ in range(n))).encode()
+
+
+class _FakeBreaker:
+    def __init__(self, degraded=False):
+        self.degraded = degraded
+        self.forced = []
+
+    def force_open(self, reason="forced"):
+        self.forced.append(reason)
+        self.degraded = True
+
+    def retry_after_s(self):
+        return 5
+
+
+# --------------------------------------------------------- state machine
+class TestStateMachine:
+    def test_transitions(self):
+        lc = ServingLifecycle(registry=MetricsRegistry())
+        assert lc.state == "starting" and lc.accepting
+        lc.mark_ready()
+        assert lc.state == "ready"
+        lc.mark_ready()  # idempotent
+        assert lc.state == "ready"
+        assert lc.begin_drain() and lc.state == "draining" and not lc.accepting
+        assert not lc.begin_drain()  # duplicate SIGTERM
+        lc.mark_stopped()
+        assert lc.state == "stopped" and not lc.accepting
+
+    def test_degraded_is_derived_from_breaker(self):
+        lc = ServingLifecycle(registry=MetricsRegistry())
+        breaker = _FakeBreaker()
+        lc.note_breaker(breaker)
+        lc.mark_ready()
+        assert lc.state == "ready"
+        breaker.degraded = True
+        assert lc.state == "degraded"
+        breaker.degraded = False
+        assert lc.state == "ready"
+        # draining trumps degraded
+        breaker.degraded = True
+        lc.begin_drain()
+        assert lc.state == "draining"
+
+    def test_mark_ready_never_undrains(self):
+        lc = ServingLifecycle(registry=MetricsRegistry())
+        lc.begin_drain()
+        lc.mark_ready()
+        assert lc.state == "draining"
+
+    def test_mark_ready_vs_drain_race_is_atomic(self):
+        # a model load completing while SIGTERM lands: whatever interleaving
+        # wins, READY must never overwrite DRAINING (a 200 /ping after the
+        # drain began would re-register the instance and wedge the drain)
+        for _ in range(50):
+            lc = ServingLifecycle(registry=MetricsRegistry())
+            barrier = threading.Barrier(2)
+
+            def ready():
+                barrier.wait()
+                lc.mark_ready()
+
+            def drain():
+                barrier.wait()
+                lc.begin_drain()
+
+            t1, t2 = threading.Thread(target=ready), threading.Thread(target=drain)
+            t1.start(); t2.start(); t1.join(); t2.join()
+            assert lc.state == "draining" and not lc.accepting
+
+    def test_degraded_reaches_gauge_and_record(self, capsys):
+        reg = MetricsRegistry()
+        lc = ServingLifecycle(registry=reg)
+        breaker = _FakeBreaker()
+        lc.note_breaker(breaker)
+        lc.mark_ready()
+        assert lc.state == "ready"
+        breaker.degraded = True
+        capsys.readouterr()
+        # reading the state (what /ping does every poll) publishes the
+        # derived value: gauge flips to 2 and one transition record emits
+        assert lc.state == "degraded"
+        assert reg.gauge("serving_state", "").value == 2.0
+        out = capsys.readouterr().out
+        assert out.count('{"metric": "serving.lifecycle"') == 1
+        assert '"state": "degraded"' in out
+        assert lc.state == "degraded"  # re-reads don't re-emit
+        assert capsys.readouterr().out == ""
+        breaker.degraded = False
+        assert lc.state == "ready"
+        assert reg.gauge("serving_state", "").value == 1.0
+
+    def test_knobs_resolve_once(self, monkeypatch):
+        monkeypatch.setenv(lifecycle.DRAIN_TIMEOUT_ENV, "7.5")
+        monkeypatch.setenv(lifecycle.REQUEST_DEADLINE_ENV, "2.5")
+        monkeypatch.setenv(lifecycle.PREDICT_STUCK_ACTION_ENV, "abort")
+        lc = ServingLifecycle(registry=MetricsRegistry())
+        monkeypatch.setenv(lifecycle.DRAIN_TIMEOUT_ENV, "99")
+        assert lc.drain_timeout_s == 7.5
+        assert lc.request_deadline_s == 2.5
+        assert lc.predict_stuck_action == "abort"
+        assert lc.request_deadline().budget_s == 2.5
+
+    def test_malformed_stuck_action_degrades_to_shed(self, monkeypatch):
+        monkeypatch.setenv(lifecycle.PREDICT_STUCK_ACTION_ENV, "explode")
+        assert ServingLifecycle(registry=MetricsRegistry()).predict_stuck_action == "shed"
+
+
+# ------------------------------------------------------- /ping semantics
+class TestPingStates:
+    def test_single_app_ping_by_state(self, model_dir):
+        service = ScoringService(model_dir)
+        app = make_app(service)
+        # no lifecycle installed: today's behavior exactly
+        assert _call(app, "GET", "/ping")[0] == 200
+
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        # model already loaded -> ping marks ready through _hooked_model
+        assert _call(app, "GET", "/ping")[0] == 200
+        assert lc.state == "ready"
+
+        lc.begin_drain()
+        status, headers, body = _call(app, "GET", "/ping")
+        assert status == 503 and "retry-after" in headers
+        assert b"draining" in body
+        # new work refused the same way
+        status, headers, _ = _call(app, "POST", "/invocations", _csv_rows(1))
+        assert status == 503 and "retry-after" in headers
+
+    def test_ping_publishes_degraded_gauge_and_record(self, model_dir, capsys):
+        # production only ever reads the derived state through /ping: a
+        # tripped breaker must reach the serving_state gauge (2) and emit
+        # a serving.lifecycle record via that path, not just flip the 503
+        service = ScoringService(model_dir)
+        app = make_app(service)
+        reg = MetricsRegistry()
+        lc = lifecycle.install(ServingLifecycle(registry=reg))
+        assert _call(app, "GET", "/ping")[0] == 200
+        service.breaker.force_open("test")
+        capsys.readouterr()
+        assert _call(app, "GET", "/ping")[0] == 503
+        assert reg.gauge("serving_state", "").value == 2.0
+        assert '"state": "degraded"' in capsys.readouterr().out
+        assert lc.state == "degraded"
+
+    def test_single_app_starting_load_failure_still_500(self, tmp_path):
+        lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        app = make_app(ScoringService(str(tmp_path)))  # empty dir: load fails
+        assert _call(app, "GET", "/ping")[0] == 500
+        assert lifecycle.current().state == "starting"
+
+    def test_mme_ping_by_state(self):
+        manager = ModelManager()
+        app = make_mme_app(manager)
+        assert _call(app, "GET", "/ping")[0] == 200
+
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        lc.mark_ready()
+        assert _call(app, "GET", "/ping")[0] == 200
+
+        manager.breaker.force_open("test")
+        status, headers, body = _call(app, "GET", "/ping")
+        assert status == 503 and b"degraded" in body and "retry-after" in headers
+
+        lc.begin_drain()
+        status, headers, body = _call(app, "GET", "/ping")
+        assert status == 503 and b"draining" in body
+        # invoke path refuses during drain too
+        status, headers, _ = _call(
+            app, "POST", "/models/m/invoke", _csv_rows(1)
+        )
+        assert status == 503 and "retry-after" in headers
+
+
+# ---------------------------------------------------- per-stage deadlines
+class TestRequestDeadline:
+    def test_deadline_math(self):
+        t = [0.0]
+        dl = RequestDeadline(1.0, clock=lambda: t[0])
+        assert not dl.expired() and dl.remaining() == pytest.approx(1.0)
+        t[0] = 0.6
+        assert dl.remaining() == pytest.approx(0.4)
+        dl.check("decode")  # within budget: no raise
+        t[0] = 1.1
+        assert dl.expired() and dl.remaining() == 0.0
+        with pytest.raises(DeadlineExceeded) as err:
+            dl.check("decode")
+        assert err.value.stage == "decode"
+        assert isinstance(err.value, TimeoutError)
+
+    def _armed_app(self, model_dir, monkeypatch, budget="0.3"):
+        monkeypatch.setenv(lifecycle.REQUEST_DEADLINE_ENV, budget)
+        lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        service = ScoringService(model_dir)
+        app = make_app(service)
+        return service, app
+
+    def _stage_count(self, stage):
+        from sagemaker_xgboost_container_tpu.telemetry import REGISTRY
+
+        return REGISTRY.counter(
+            "serving_deadline_exceeded_total", "", {"stage": stage}
+        ).value
+
+    def test_decode_stage_expiry(self, model_dir, monkeypatch):
+        _, app = self._armed_app(model_dir, monkeypatch)
+        before = self._stage_count("decode")
+        faults.configure("serving.decode:sleep:0.5")
+        status, headers, body = _call(app, "POST", "/invocations", _csv_rows(1))
+        assert status == 503 and "retry-after" in headers
+        assert b"decode" in body
+        assert self._stage_count("decode") == before + 1
+
+    def test_predict_stage_expiry_and_breaker_feed(self, model_dir, monkeypatch):
+        service, app = self._armed_app(model_dir, monkeypatch)
+        before = self._stage_count("predict")
+        # rows > GRAFT_HOST_PREDICT_ROWS so the request takes the queue path
+        # (inline would finish before any wait); the wedged dispatch burns
+        # the whole budget mid-flight -> `predict` stage
+        faults.configure("batcher.dispatch:sleep:1.0")
+        status, headers, _ = _call(app, "POST", "/invocations", _csv_rows(40))
+        assert status == 503 and "retry-after" in headers
+        assert self._stage_count("predict") == before + 1
+        # the expiry fed the breaker like any other saturation event
+        assert service.breaker._consecutive >= 1
+
+    def test_encode_stage_expiry(self, model_dir, monkeypatch):
+        service, app = self._armed_app(model_dir, monkeypatch)
+        before = self._stage_count("encode")
+        faults.configure("serving.encode:sleep:0.5")
+        status, headers, body = _call(app, "POST", "/invocations", _csv_rows(1))
+        assert status == 503 and b"encode" in body
+        assert self._stage_count("encode") == before + 1
+        # an encode-expiry storm must be able to open the breaker: success
+        # is only recorded AFTER the encode check, so consecutive saturation
+        # accumulates instead of oscillating 0/1 forever
+        status, _, _ = _call(app, "POST", "/invocations", _csv_rows(1))
+        assert status == 503
+        assert service.breaker._consecutive == 2
+
+    def test_predict_fn_hook_expiry_bills_predict_stage(self, model_dir, monkeypatch):
+        monkeypatch.setenv(lifecycle.REQUEST_DEADLINE_ENV, "0.2")
+        lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+
+        def slow_predict_fn(data, model):
+            time.sleep(0.4)
+            return [0.5]
+
+        app = make_app(
+            ScoringService(model_dir), hooks={"predict_fn": slow_predict_fn}
+        )
+        before = self._stage_count("predict")
+        status, headers, body = _call(app, "POST", "/invocations", _csv_rows(1))
+        assert status == 503 and b"predict" in body
+        assert self._stage_count("predict") == before + 1
+
+    def test_queue_stage_expiry_in_batcher(self):
+        release = threading.Event()
+
+        def slow_predict(feats):
+            release.wait(5.0)
+            return np.zeros(feats.shape[0], np.float32)
+
+        batcher = PredictBatcher(slow_predict, registry=MetricsRegistry())
+        try:
+            wide = np.zeros((64, 3), np.float32)  # past the inline cutover
+            first_out = []
+            t = threading.Thread(
+                target=lambda: first_out.append(batcher.predict(wide, timeout=10)),
+                daemon=True,
+            )
+            t.start()
+            deadline = time.monotonic() + 5
+            while batcher.dispatch_age_s() is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # second request queues behind the in-flight dispatch and its
+            # budget dies BEFORE its batch dispatches -> `queue` stage
+            with pytest.raises(DeadlineExceeded) as err:
+                batcher.predict(wide, timeout=10, deadline=RequestDeadline(0.15))
+            assert err.value.stage == "queue"
+            release.set()
+            t.join(timeout=5)
+            assert first_out and len(first_out[0]) == 64
+        finally:
+            release.set()
+
+    def test_exhausted_budget_never_enqueues(self):
+        batcher = PredictBatcher(
+            lambda feats: np.zeros(feats.shape[0], np.float32),
+            registry=MetricsRegistry(),
+        )
+        dl = RequestDeadline(0.0)
+        time.sleep(0.01)
+        with pytest.raises(DeadlineExceeded) as err:
+            batcher.predict(np.zeros((64, 3), np.float32), deadline=dl)
+        assert err.value.stage == "queue"
+
+    def test_no_deadline_means_legacy_behavior(self, model_dir):
+        # knob unset: request_deadline() is None and requests flow untouched
+        lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        assert lifecycle.request_deadline() is None
+        app = make_app(ScoringService(model_dir))
+        status, _, body = _call(app, "POST", "/invocations", _csv_rows(2))
+        assert status == 200 and len(body.strip().splitlines()) == 2
+
+
+# ------------------------------------------------------------ in-flight latch
+class TestInflightLatch:
+    def test_latch_counts_until_body_close(self, model_dir):
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        app = make_app(ScoringService(model_dir))
+        environ = {
+            "REQUEST_METHOD": "POST",
+            "PATH_INFO": "/invocations",
+            "CONTENT_LENGTH": str(len(_csv_rows(1))),
+            "CONTENT_TYPE": "text/csv",
+            "wsgi.input": io.BytesIO(_csv_rows(1)),
+        }
+        result = app(environ, lambda status, headers, exc_info=None: None)
+        body = b"".join(result)
+        # the app returned but the body is not "written" until close():
+        # exiting now would truncate the response, so the latch still holds
+        assert lc.inflight == 1 and body
+        result.close()
+        assert lc.inflight == 0
+
+    def test_latch_releases_on_app_exception(self):
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        from sagemaker_xgboost_container_tpu.telemetry import instrument_wsgi
+
+        def broken_app(environ, start_response):
+            raise RuntimeError("boom")
+
+        app = instrument_wsgi(broken_app)
+        with pytest.raises(RuntimeError):
+            _call(app, "GET", "/anything")
+        assert lc.inflight == 0
+
+    def test_drain_refused_requests_do_not_hold_the_latch(self):
+        # LB health checks and client retries keep hitting a draining
+        # instance; their fast 503s must not keep inflight > 0 or a busy
+        # endpoint could never drain cleanly (spurious exit 83)
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        from sagemaker_xgboost_container_tpu.telemetry import instrument_wsgi
+
+        seen_inflight = []
+
+        def probe_app(environ, start_response):
+            seen_inflight.append(lc.inflight)
+            start_response("503 Service Unavailable", [("Content-Type", "text/plain")])
+            return [b"draining"]
+
+        app = instrument_wsgi(probe_app)
+        lc.begin_drain()
+        _call(app, "GET", "/ping")
+        assert seen_inflight == [0]
+        assert lc.inflight == 0
+        assert lc.wait_drained(0.01)
+
+    def test_wait_drained(self):
+        lc = ServingLifecycle(registry=MetricsRegistry())
+        lc.request_started()
+        assert not lc.wait_drained(0.05)
+        threading.Timer(0.1, lc.request_finished).start()
+        assert lc.wait_drained(2.0)
+        assert lc.inflight == 0
+
+
+# ------------------------------------------------------------------- drain
+class TestDrain:
+    def _serve(self, app):
+        from wsgiref.simple_server import make_server
+
+        from sagemaker_xgboost_container_tpu.serving.server import (
+            _QuietHandler,
+            _ThreadedWSGIServer,
+        )
+
+        httpd = make_server(
+            "127.0.0.1", 0, app,
+            server_class=_ThreadedWSGIServer, handler_class=_QuietHandler,
+        )
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return "http://127.0.0.1:{}".format(httpd.server_address[1]), httpd
+
+    def _post(self, base, body, timeout=30):
+        req = urllib.request.Request(
+            base + "/invocations", data=body, method="POST",
+            headers={"Content-Type": "text/csv"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def test_drain_completes_inflight_then_stops(self, model_dir):
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        app = make_app(ScoringService(model_dir))
+        base, httpd = self._serve(app)
+        try:
+            assert self._post(base, _csv_rows(1))[0] == 200  # warm load
+            faults.configure("batcher.dispatch:sleep:0.8")
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(self._post(base, _csv_rows(40))),
+                daemon=True,
+            )
+            t.start()
+            deadline = time.monotonic() + 5
+            while lc.inflight == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert lc.inflight >= 1
+            done = []
+            drainer = threading.Thread(
+                target=lambda: done.append(drain_and_shutdown(httpd, lc)),
+                daemon=True,
+            )
+            drainer.start()
+            deadline = time.monotonic() + 5
+            while lc.state != "draining" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # new work during the drain: orderly 503 + Retry-After
+            status, _, headers = self._post(base, _csv_rows(1), timeout=10)
+            assert status == 503 and headers.get("Retry-After")
+            drainer.join(timeout=30)
+            t.join(timeout=30)
+            # the in-flight request finished with a full body — zero drops
+            assert results and results[0][0] == 200
+            assert len(results[0][1].strip().splitlines()) == 40
+            assert done == [True]
+            assert lc.state == "stopped"
+        finally:
+            faults.reset()
+            try:
+                httpd.server_close()
+            except OSError:
+                pass
+
+    def test_drain_timeout_exits_83_with_dump(self, model_dir, monkeypatch):
+        monkeypatch.setenv(lifecycle.DRAIN_TIMEOUT_ENV, "0.2")
+        exits = []
+        monkeypatch.setattr(lifecycle, "_exit", lambda code: exits.append(code))
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        app = make_app(ScoringService(model_dir))
+        base, httpd = self._serve(app)
+        release = threading.Event()
+        try:
+            assert self._post(base, _csv_rows(1))[0] == 200
+            faults.configure("batcher.dispatch:sleep:30")
+
+            def wedged():
+                try:
+                    self._post(base, _csv_rows(40), timeout=3)
+                except Exception:
+                    pass
+
+            threading.Thread(target=wedged, daemon=True).start()
+            deadline = time.monotonic() + 5
+            while lc.inflight == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not drain_and_shutdown(httpd, lc)
+            assert exits == [83]
+        finally:
+            faults.reset()
+            release.set()
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass
+
+    def test_legacy_mode_skips_wait_but_stops_orderly(self, model_dir, monkeypatch):
+        monkeypatch.setenv(lifecycle.GRACEFUL_DRAIN_ENV, "false")
+        lc = lifecycle.install(ServingLifecycle(registry=MetricsRegistry()))
+        app = make_app(ScoringService(model_dir))
+        base, httpd = self._serve(app)
+        assert self._post(base, _csv_rows(1))[0] == 200
+        t0 = time.monotonic()
+        assert drain_and_shutdown(httpd, lc)
+        assert time.monotonic() - t0 < 5.0
+        assert lc.state == "stopped"
+
+
+# ---------------------------------------------------------- predict watchdog
+class _StuckableBatcher:
+    def __init__(self):
+        self.age = None
+        self.info = (0, 0)
+
+    def dispatch_age_s(self):
+        return self.age
+
+    def dispatch_info(self):
+        return self.info
+
+
+class TestPredictWatchdog:
+    def test_shed_action_trips_breaker_once_per_episode(self, capsys):
+        wd = PredictWatchdog(1.0, action="shed", check_interval=1000)
+        batcher = _StuckableBatcher()
+        breaker = _FakeBreaker()
+        wd.register("single", batcher, breaker)
+        try:
+            wd.check_once()  # idle: nothing
+            assert breaker.forced == []
+            batcher.age = 2.5
+            batcher.info = (3, 120)
+            wd.check_once()
+            wd.check_once()  # still stuck: breaker re-forced, record NOT re-emitted
+            assert breaker.forced == ["predict_stuck", "predict_stuck"]
+            records = [
+                json.loads(l)
+                for l in capsys.readouterr().out.splitlines()
+                if l.startswith('{"metric": "serving.stuck"')
+            ]
+            assert len(records) == 1
+            assert records[0]["batcher"] == "single"
+            assert records[0]["requests"] == 3 and records[0]["rows"] == 120
+            # recovery clears the episode; a second wedge is a new record
+            batcher.age = None
+            wd.check_once()
+            batcher.age = 3.0
+            wd.check_once()
+            out = capsys.readouterr().out
+            assert out.count('{"metric": "serving.stuck"') == 1
+        finally:
+            wd.stop()
+
+    def test_abort_action_exits_84(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr(lifecycle, "_exit", lambda code: exits.append(code))
+        wd = PredictWatchdog(1.0, action="abort", check_interval=1000)
+        batcher = _StuckableBatcher()
+        batcher.age = 5.0
+        wd.register("single", batcher, None)
+        try:
+            wd.check_once()
+            assert exits == [84]
+        finally:
+            wd.stop()
+
+    def test_real_batcher_reports_dispatch_age(self):
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_predict(feats):
+            started.set()
+            release.wait(5.0)
+            return np.zeros(feats.shape[0], np.float32)
+
+        batcher = PredictBatcher(slow_predict, registry=MetricsRegistry())
+        try:
+            assert batcher.dispatch_age_s() is None
+            t = threading.Thread(
+                target=lambda: batcher.predict(np.zeros((64, 3), np.float32)),
+                daemon=True,
+            )
+            t.start()
+            assert started.wait(5.0)
+            time.sleep(0.05)
+            age = batcher.dispatch_age_s()
+            assert age is not None and age > 0
+            assert batcher.dispatch_info() == (1, 64)
+            release.set()
+            t.join(timeout=5)
+            assert batcher.dispatch_age_s() is None
+        finally:
+            release.set()
+
+    def test_check_interval_outpaces_breaker_cooldown(self, monkeypatch):
+        # a 60s stuck deadline with the default 5s cooldown must still
+        # re-force the breaker before it half-opens, or /ping flaps a
+        # wedged instance back into rotation between checks
+        monkeypatch.delenv("SM_SHED_COOLDOWN_S", raising=False)
+        wd = PredictWatchdog(60.0)
+        assert wd.check_interval <= 2.5
+        # an explicit interval is honored untouched (tests pass huge ones)
+        assert PredictWatchdog(60.0, check_interval=1000).check_interval == 1000
+
+    def test_restart_after_stop_really_arms(self):
+        wd = PredictWatchdog(1.0, check_interval=0.05)
+        batcher = _StuckableBatcher()
+        breaker = _FakeBreaker()
+        wd.register("single", batcher, breaker)
+        wd.stop()
+        # re-register: the fresh thread must poll (a stale set Event would
+        # make it exit on its first wait — an armed-looking no-op)
+        wd.register("single", batcher, breaker)
+        try:
+            assert wd._thread is not None and wd._thread.is_alive()
+            batcher.age = 5.0
+            deadline = time.monotonic() + 5
+            while not breaker.forced and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert breaker.forced
+        finally:
+            wd.stop()
+
+    def test_lifecycle_gates_watchdog_on_knob(self, monkeypatch):
+        assert ServingLifecycle(registry=MetricsRegistry()).watchdog is None
+        monkeypatch.setenv(lifecycle.PREDICT_STUCK_ENV, "2.0")
+        lc = ServingLifecycle(registry=MetricsRegistry())
+        assert lc.watchdog is not None and lc.watchdog.stuck_s == 2.0
+        lc.shutdown()
+
+    def test_force_open_real_breaker_flips_ping_and_recovers(self):
+        clock = [0.0]
+        breaker = CircuitBreaker(
+            name="wdtest", threshold=5, cooldown_s=10.0,
+            registry=MetricsRegistry(), clock=lambda: clock[0],
+        )
+        assert breaker.allow() and not breaker.degraded
+        breaker.force_open("predict_stuck")
+        assert breaker.degraded and not breaker.allow()
+        # re-forcing restarts the cooldown
+        clock[0] = 8.0
+        breaker.force_open("predict_stuck")
+        clock[0] = 12.0
+        assert breaker.degraded  # 10s cooldown from t=8, not t=0
+        clock[0] = 19.0
+        assert not breaker.degraded  # half-open: ready for the probe
+        assert breaker.allow()
+        breaker.record_success()
+        assert breaker.state == "closed"
+
+
+# ------------------------------------------------------- user-hook hygiene
+class TestUserHookLoading:
+    def _load(self, model_dir):
+        from sagemaker_xgboost_container_tpu.serving.server import _load_user_hooks
+
+        return _load_user_hooks(model_dir)
+
+    def test_broken_script_rolls_back_path_and_modules(self, tmp_path, monkeypatch):
+        script = tmp_path / "inference.py"
+        script.write_text("raise ImportError('broken user script')\n")
+        monkeypatch.setenv("SAGEMAKER_PROGRAM", "inference.py")
+        monkeypatch.setenv("SAGEMAKER_SUBMIT_DIRECTORY", str(tmp_path))
+        path_before = list(sys.path)
+        modules_before = set(sys.modules)
+        with pytest.raises(ImportError):
+            self._load(str(tmp_path))
+        assert sys.path == path_before
+        leaked = {
+            name for name in set(sys.modules) - modules_before
+            if name.startswith("user_inference")
+        }
+        assert not leaked  # no half-initialized module to poison a retry
+        # the retried load works once the script is fixed — nothing poisoned
+        script.write_text(
+            "def model_fn(model_dir):\n    return 'model'\n"
+            "def predict_fn(data, model):\n    return [1.0]\n"
+        )
+        hooks = self._load(str(tmp_path))
+        assert sorted(hooks) == ["model_fn", "predict_fn"]
+        assert hooks["model_fn"]("x") == "model"
+
+    def test_distinct_scripts_get_distinct_module_names(self, tmp_path, monkeypatch):
+        a, b = tmp_path / "a", tmp_path / "b"
+        for d, val in ((a, "1.0"), (b, "2.0")):
+            d.mkdir()
+            (d / "inference.py").write_text(
+                "def model_fn(model_dir):\n    return {}\n".format(val)
+            )
+        monkeypatch.setenv("SAGEMAKER_PROGRAM", "inference.py")
+        monkeypatch.setenv("SAGEMAKER_SUBMIT_DIRECTORY", str(a))
+        hooks_a = self._load(str(a))
+        monkeypatch.setenv("SAGEMAKER_SUBMIT_DIRECTORY", str(b))
+        hooks_b = self._load(str(b))
+        # a fixed module name would alias the second script onto the first
+        assert hooks_a["model_fn"]("x") == 1.0
+        assert hooks_b["model_fn"]("x") == 2.0

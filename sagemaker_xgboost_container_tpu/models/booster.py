@@ -21,6 +21,7 @@ bool stop / after_training) so the orchestration layer's checkpoint, early
 stop, and monitor callbacks port naturally.
 """
 
+import functools
 import logging
 import os
 from functools import partial
@@ -245,6 +246,97 @@ def _apply_packed_tree(packed, bins, margins, num_group, num_parallel, depth,
     else:
         deltas = jax.vmap(one)(tree)
     return margins + deltas.T
+
+
+@functools.lru_cache(maxsize=None)
+def _calibrated_comm_ms(mesh, hist_comm, plan_key):
+    """Standalone timing of one round's data-axis collectives (ms).
+
+    lru_cached module factory: one calibration per (mesh, lowering, plan
+    shapes) per PROCESS, not per session — a CV fold rebuild or an elastic
+    reform that lands on an identical plan skips the compile + timing
+    dispatches entirely (jax Meshes hash by device assignment + axis
+    names, so a genuinely different topology still re-calibrates).
+
+    Each DISTINCT payload shape in ``plan_key`` (tuples of
+    ``(kind, shape, count)`` from ``round_comm_plan``) is timed as a
+    standalone jitted collective on zeros (min of 3 reps after a warmup)
+    and the per-round estimate is the count-weighted sum. An
+    isolated-latency estimate: real rounds overlap collectives with
+    compute (GRAFT_HIST_OVERLAP pipelines them on purpose), so this is an
+    upper bound on the comm share. Raises on failure — lru_cache does NOT
+    memoize raising calls, so a transient failure (device momentarily
+    busy) is retried by the next session rebuild instead of pinning the
+    gauge to a cached 0.0 for the rest of the process; the caller
+    (_calibrate_hist_comm_ms) catches and degrades to 0.0 for ITS session.
+    """
+    import time
+
+    def psum_fn(x):
+        return jax.lax.psum(x, "data")
+
+    def scatter_fn(x):
+        return jax.lax.psum_scatter(
+            x, "data", scatter_dimension=1, tiled=True
+        )
+
+    total_s = 0.0
+    timed = {}
+    for kind, shape, count in plan_key:
+        key = (kind, shape)
+        if key not in timed:
+            if kind == "hist" and hist_comm == "reduce_scatter":
+                fn, out_spec = scatter_fn, P(None, "data", None)
+            else:
+                fn, out_spec = psum_fn, P()
+            # graftlint: disable=trace-uncached-jit — calibration-scope: lru_cached module factory, one standalone collective timing per distinct (mesh, plan shape, impl) per process, off the round path
+            mapped = jax.jit(
+                shard_map(
+                    fn,
+                    mesh=mesh,
+                    in_specs=(P(),),
+                    out_specs=out_spec,
+                    **_SHARD_MAP_REP_KW,
+                )
+            )
+            x = jnp.zeros(shape, jnp.float32)
+            jax.block_until_ready(mapped(x))  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(mapped(x))
+                best = min(best, time.perf_counter() - t0)
+            timed[key] = best
+        # one timing covers one tensor; the round moves G and H
+        total_s += timed[key] * 2 * count
+    return total_s * 1000.0
+
+
+_approx_k_forcing_warned = False
+
+
+def _warn_approx_k_forcing_once(requested):
+    """Warn (once per process) that the approx re-sketch forces K -> 1.
+
+    libxgboost's approx refreshes split candidates every ITERATION; a
+    K-round dispatch would re-sketch only once per K rounds — a silent
+    semantic weakening (ADVICE r5). GRAFT_APPROX_RESKETCH=0 restores
+    batched dispatches (single global sketch, hist semantics) —
+    docs/MIGRATION.md. Every CV fold / elastic generation rebuilds the
+    session, so the log is deduplicated here rather than spamming one
+    line per rebuild.
+    """
+    global _approx_k_forcing_warned
+    if _approx_k_forcing_warned:
+        return
+    _approx_k_forcing_warned = True
+    logger.warning(
+        "tree_method='approx' re-sketches candidates before every "
+        "boosting iteration; forcing _rounds_per_dispatch=%d -> 1 "
+        "(set GRAFT_APPROX_RESKETCH=0 to keep batched dispatches "
+        "with a single global sketch).",
+        requested,
+    )
 
 
 def _pad_rows(array, target_rows, fill):
@@ -567,18 +659,7 @@ class _TrainingSession:
 
         self.rounds_per_dispatch = max(1, config.rounds_per_dispatch)
         if self.approx_resketch and self.rounds_per_dispatch > 1:
-            # libxgboost's approx refreshes split candidates every ITERATION;
-            # a K-round dispatch would re-sketch only once per K rounds — a
-            # silent semantic weakening (ADVICE r5). Keep per-iteration
-            # semantics; GRAFT_APPROX_RESKETCH=0 restores batched dispatches
-            # (single global sketch, hist semantics). docs/MIGRATION.md.
-            logger.info(
-                "tree_method='approx' re-sketches candidates before every "
-                "boosting iteration; forcing _rounds_per_dispatch=%d -> 1 "
-                "(set GRAFT_APPROX_RESKETCH=0 to keep batched dispatches "
-                "with a single global sketch).",
-                self.rounds_per_dispatch,
-            )
+            _warn_approx_k_forcing_once(self.rounds_per_dispatch)
             self.rounds_per_dispatch = 1
         self.device_metric_fns = None
         # Device metrics decompose into psum-able partial stats
@@ -606,21 +687,37 @@ class _TrainingSession:
             )
             if self.device_metric_fns is not None:
                 self.device_metric_names = list(metric_names)
-        if (
+        # Metrics outside device_metrics.all_supported (feval, ranking
+        # metrics, non-decomposable scalars) no longer force K -> 1: the
+        # fused dispatch keeps K, the scan carries every eval set's margins
+        # on device, and the HOST evaluates once per dispatch — metric
+        # lines land every K rounds at the batch-end round index instead of
+        # every round (the documented host-fallback cadence, docs/DESIGN.md
+        # §Round pipeline; callbacks skip stale rounds).
+        self.host_eval_batched = (
             self.rounds_per_dispatch > 1
-            and self.eval_sets
+            and bool(self.eval_sets)
             and self.device_metric_fns is None
-        ):
-            logger.warning(
-                "_rounds_per_dispatch > 1 needs device-computable per-round "
-                "eval metrics; falling back to 1."
+        )
+        if self.host_eval_batched:
+            logger.info(
+                "_rounds_per_dispatch=%d with eval metrics that cannot ride "
+                "back from the device: keeping the fused dispatch; host "
+                "metrics are computed once per dispatch (every %d rounds).",
+                self.rounds_per_dispatch, self.rounds_per_dispatch,
             )
-            self.rounds_per_dispatch = 1
         # the lax.scan round path carries eval margins + metric stats on
         # device; used for K > 1 and for exact multi-process evaluation
         self.use_scan_rounds = self.rounds_per_dispatch > 1 or (
             self.device_metric_fns is not None and self.is_multiprocess
         )
+        from ..telemetry import REGISTRY
+
+        REGISTRY.gauge(
+            "dispatch_fused_rounds",
+            "Boosting rounds fused into one device dispatch per round "
+            "program (the lax.scan length K of the fused round pipeline)",
+        ).set(self.rounds_per_dispatch)
 
         monotone = np.zeros(self.d_pad, np.int32)
         if config.monotone_constraints:
@@ -863,50 +960,58 @@ class _TrainingSession:
                     bins, margins_c, labels, weights, num_cuts, rng_j, mask,
                     monotone, rank_index,
                 )
-                if metric_fns:
-                    new_extra = []
-                    per_set = []
-                    ei = 0
-                    for si, shared in enumerate(shared_flags):
-                        if shared:
-                            m_e, y_e, w_e = margins_c, labels, weights
-                        else:
-                            b_e, y_e, w_e = eval_blw[ei]
-                            m_e = _apply_packed_tree(
-                                packed, b_e, extra[ei],
-                                num_group, num_parallel, predict_depth, num_bins,
-                                route_impl=self.hist_knobs.route_impl,
-                            )
-                            new_extra.append(m_e)
-                            ei += 1
-                        # shard-local partial stats -> psum over the data
-                        # axis -> finalize: metric scalars are globally
-                        # exact and identical on every shard/host. The
-                        # non-decomposable exception (cox-nloglik) gathers
-                        # the global rows first — its replicated stats are
-                        # pre-divided by the axis size so the shared psum
-                        # restores the global value.
-                        def _stats_for(fn, m_s, y_s, w_s):
-                            if fn.needs_global_rows and axis_name is not None:
-                                m_g = jax.lax.all_gather(m_s, axis_name, tiled=True)
-                                y_g = jax.lax.all_gather(y_s, axis_name, tiled=True)
-                                w_g = jax.lax.all_gather(w_s, axis_name, tiled=True)
-                                return fn.partial(m_g, y_g, w_g) / n_data_shards
-                            return fn.partial(m_s, y_s, w_s)
-
-                        stats = jnp.concatenate(
-                            [_stats_for(fn, m_e, y_e, w_e) for fn in metric_fns]
+                # every non-shared eval set's margins ride the scan carry:
+                # the committed tree applies on device each round whether or
+                # not metrics are device-computable, so the host-fallback
+                # cadence (evaluate once per dispatch) reads fresh margins
+                # without a single extra dispatch, and the carried buffers
+                # stay donated round over round (donate_argnums below).
+                new_extra = []
+                per_set = []
+                ei = 0
+                for si, shared in enumerate(shared_flags):
+                    if shared:
+                        m_e, y_e, w_e = margins_c, labels, weights
+                    else:
+                        b_e, y_e, w_e = eval_blw[ei]
+                        m_e = _apply_packed_tree(
+                            packed, b_e, extra[ei],
+                            num_group, num_parallel, predict_depth, num_bins,
+                            route_impl=self.hist_knobs.route_impl,
                         )
-                        if axis_name is not None:
-                            stats = jax.lax.psum(stats, axis_name)
-                        scalars_set = []
-                        off = 0
-                        for fn in metric_fns:
-                            scalars_set.append(fn.finalize(stats[off : off + fn.size]))
-                            off += fn.size
-                        per_set.append(jnp.stack(scalars_set))
+                        new_extra.append(m_e)
+                        ei += 1
+                    if not metric_fns:
+                        continue
+                    # shard-local partial stats -> psum over the data
+                    # axis -> finalize: metric scalars are globally
+                    # exact and identical on every shard/host. The
+                    # non-decomposable exception (cox-nloglik) gathers
+                    # the global rows first — its replicated stats are
+                    # pre-divided by the axis size so the shared psum
+                    # restores the global value.
+                    def _stats_for(fn, m_s, y_s, w_s):
+                        if fn.needs_global_rows and axis_name is not None:
+                            m_g = jax.lax.all_gather(m_s, axis_name, tiled=True)
+                            y_g = jax.lax.all_gather(y_s, axis_name, tiled=True)
+                            w_g = jax.lax.all_gather(w_s, axis_name, tiled=True)
+                            return fn.partial(m_g, y_g, w_g) / n_data_shards
+                        return fn.partial(m_s, y_s, w_s)
+
+                    stats = jnp.concatenate(
+                        [_stats_for(fn, m_e, y_e, w_e) for fn in metric_fns]
+                    )
+                    if axis_name is not None:
+                        stats = jax.lax.psum(stats, axis_name)
+                    scalars_set = []
+                    off = 0
+                    for fn in metric_fns:
+                        scalars_set.append(fn.finalize(stats[off : off + fn.size]))
+                        off += fn.size
+                    per_set.append(jnp.stack(scalars_set))
+                extra = tuple(new_extra)
+                if metric_fns:
                     scalars = jnp.stack(per_set)          # [n_sets, n_metrics]
-                    extra = tuple(new_extra)
                 else:
                     # non-empty dummy: zero-sized scan outputs are a
                     # lowering hazard on some backends
@@ -1050,64 +1155,28 @@ class _TrainingSession:
     def _calibrate_hist_comm_ms(self):
         """Isolated latency of one round's data-axis collectives, in ms.
 
-        The round program fuses collectives with compute, so their share of
-        round time is not observable host-side; instead each DISTINCT
-        payload shape in the comm plan is timed as a standalone jitted
-        collective on zeros (min of 3 reps after a warmup) and the per-round
-        estimate is the count-weighted sum. An isolated-latency estimate:
-        real rounds may overlap collectives with compute, so this is an
-        upper bound on the comm share. Returns 0.0 when calibration is
-        disabled (GRAFT_HIST_COMM_CALIBRATE=0) or fails.
+        Delegates to the module-level lru_cached factory keyed by
+        (mesh, lowering, plan shapes): a session rebuilt on the same mesh
+        with the same static plan — every sequential CV fold, an elastic
+        generation that kept its topology, a dart staging rebuild — reuses
+        the measured number instead of re-paying the standalone collective
+        compile + timing dispatches on its first round. Returns 0.0 when
+        calibration is disabled (GRAFT_HIST_COMM_CALIBRATE=0) or fails.
         """
         if not self.hist_comm_plan:
             return 0.0
         if os.environ.get("GRAFT_HIST_COMM_CALIBRATE", "1") != "1":
             return 0.0
-        import time
-
-        def psum_fn(x):
-            return jax.lax.psum(x, "data")
-
-        def scatter_fn(x):
-            return jax.lax.psum_scatter(
-                x, "data", scatter_dimension=1, tiled=True
-            )
-
+        plan_key = tuple(
+            (entry["kind"], entry["shape"], entry["count"])
+            for entry in self.hist_comm_plan
+        )
         try:
-            total_s = 0.0
-            timed = {}
-            for entry in self.hist_comm_plan:
-                key = (entry["kind"], entry["shape"])
-                if key not in timed:
-                    if (
-                        entry["kind"] == "hist"
-                        and self.hist_comm == "reduce_scatter"
-                    ):
-                        fn, out_spec = scatter_fn, P(None, "data", None)
-                    else:
-                        fn, out_spec = psum_fn, P()
-                    # graftlint: disable=trace-uncached-jit — calibration-scope: one standalone collective timing per distinct payload shape per session, off the round path
-                    mapped = jax.jit(
-                        shard_map(
-                            fn,
-                            mesh=self.mesh,
-                            in_specs=(P(),),
-                            out_specs=out_spec,
-                            **_SHARD_MAP_REP_KW,
-                        )
-                    )
-                    x = jnp.zeros(entry["shape"], jnp.float32)
-                    jax.block_until_ready(mapped(x))  # compile + warm
-                    best = float("inf")
-                    for _ in range(3):
-                        t0 = time.perf_counter()
-                        jax.block_until_ready(mapped(x))
-                        best = min(best, time.perf_counter() - t0)
-                    timed[key] = best
-                # one timing covers one tensor; the round moves G and H
-                total_s += timed[key] * 2 * entry["count"]
-            return total_s * 1000.0
+            return _calibrated_comm_ms(self.mesh, self.hist_comm, plan_key)
         except Exception as e:  # calibration must never break training
+            # degrade THIS session to 0.0 only: a raising call is not
+            # memoized by lru_cache, so the next session rebuild retries
+            # instead of serving a cached failure forever
             logger.warning("hist comm calibration failed: %s", e)
             return 0.0
 
@@ -1390,7 +1459,7 @@ class _TrainingSession:
             return self._to_host(self.margins, self.n)
         return self._to_host(m, dm.num_row)
 
-    def evaluate(self, metric_names, feval=None):
+    def evaluate(self, metric_names, feval=None, forest=None):
         """Returns list of (data_name, metric_name, value) per eval set.
 
         In multi-process runs each host computes on its local shard and the
@@ -1400,13 +1469,38 @@ class _TrainingSession:
         ride the exact device psum path instead). This mirrors distributed
         xgboost, where python-side custom metrics are computed per worker
         and averaged rather than allreduced elementwise.
+
+        forest: evaluate from the COMMITTED forest's margins instead of the
+        session's device margins. Used by the host-fallback cadence when
+        the final dispatch over-built (num_boost_round not a multiple of K,
+        or an early stop mid-batch): the device margins then include
+        discarded trees, so the last metric line — the one HPO reads —
+        must come from the forest that was actually kept. Cost note: this
+        re-predicts each eval set (train watchlist included) with the
+        whole-forest predictor, once per job at the final round — exactness
+        of the final line is deliberately bought with one extra predict
+        pass; sizing num_boost_round to a multiple of K avoids it entirely.
         """
         if not hasattr(self, "_global_rows_cache"):
             self._global_rows_cache = {}
-        entries = (
-            (name, dm, self.margins_for(i))
-            for i, (name, dm, _binned) in enumerate(self.eval_sets)
-        )
+        if forest is not None:
+            def _committed_margin(dm):
+                m = np.asarray(forest.predict_margin(dm.features), np.float32)
+                return m.reshape(
+                    (dm.num_row,)
+                    if self.num_group == 1
+                    else (dm.num_row, self.num_group)
+                )
+
+            entries = (
+                (name, dm, _committed_margin(dm))
+                for name, dm, _binned in self.eval_sets
+            )
+        else:
+            entries = (
+                (name, dm, self.margins_for(i))
+                for i, (name, dm, _binned) in enumerate(self.eval_sets)
+            )
         return evaluate_host_lines(
             entries,
             metric_names,
@@ -1757,10 +1851,24 @@ def train(
                     for si, (name, _dm, _b) in enumerate(session.eval_sets)
                     for i, metric_name in enumerate(session.device_metric_names)
                 ]
-            elif session.eval_sets:
-                results = session.evaluate(metric_names, feval=feval)
-            else:
+            elif not session.eval_sets:
                 results = []
+            elif not session.host_eval_batched:
+                results = session.evaluate(metric_names, feval=feval)
+            elif j == len(trees_batch) - 1:
+                # host-fallback cadence: the fused K-round dispatch finished
+                # and the device margins cover exactly the committed trees —
+                # one host evaluation per dispatch, attributed to the
+                # batch-end round.
+                results = session.evaluate(metric_names, feval=feval)
+            elif rnd == end_round - 1:
+                # final round lands mid-batch (num_boost_round % K != 0):
+                # the device margins include the over-built, discarded trees
+                # — evaluate the committed forest so the last metric line
+                # (the one HPO reads) is exact.
+                results = session.evaluate(metric_names, feval=feval, forest=forest)
+            else:
+                results = []  # stale round inside the fused batch
             for data_name, metric_name, value in results:
                 evals_log.setdefault(data_name, {}).setdefault(metric_name, []).append(value)
 

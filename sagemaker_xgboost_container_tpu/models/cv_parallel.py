@@ -122,6 +122,13 @@ def train_cv_parallel(
         interaction_sets = jnp.asarray(sets_np)
 
     k_rounds = max(1, cfg.rounds_per_dispatch)
+    from ..telemetry import REGISTRY
+
+    REGISTRY.gauge(
+        "dispatch_fused_rounds",
+        "Boosting rounds fused into one device dispatch per round "
+        "program (the lax.scan length K of the fused round pipeline)",
+    ).set(k_rounds)
 
     # knob snapshot for the traced build (trace-safety: no env reads under
     # trace) — resolved here, host-side, once per CV dispatch program

@@ -56,6 +56,7 @@ HistKnobs = collections.namedtuple(
         "vnode_vmem",    # GRAFT_VNODE_VMEM
         "subtract",      # GRAFT_HIST_SUBTRACT
         "subtract_mem",  # GRAFT_SUBTRACT_MEM
+        "comm_overlap",  # GRAFT_HIST_OVERLAP
     ],
 )
 
@@ -79,6 +80,7 @@ def resolve_hist_knobs():
         vnode_vmem=env_int("GRAFT_VNODE_VMEM", 4 * 1024 * 1024, minimum=0),
         subtract=os.environ.get("GRAFT_HIST_SUBTRACT", "1") == "1",
         subtract_mem=env_int("GRAFT_SUBTRACT_MEM", 512 * 1024 * 1024, minimum=0),
+        comm_overlap=_comm_overlap(),
     )
 
 
@@ -136,6 +138,56 @@ def _matmul_precision():
     # graftlint: disable=trace-env-read — direct-caller fallback only;
     # sessions snapshot this via resolve_hist_knobs() at build time
     return os.environ.get("GRAFT_HIST_MM_PREC", "bf16x2")
+
+
+def _comm_overlap():
+    """GRAFT_HIST_OVERLAP: pipeline the per-level histogram collectives.
+
+    When enabled (default), a tree level's node axis is split into two
+    independent collective -> split-scan batches (overlap_node_batches), so
+    the collective for the second node batch is in flight while the first
+    batch's gain scan runs — XLA's latency-hiding scheduler can overlap
+    the wire time with compute. Values are bit-identical either way: each
+    node's histogram is reduced whole by exactly one collective in the
+    same shard order. ``0`` restores the single fused per-level collective
+    (A/B lever; also the fallback if a backend's scheduler serializes the
+    split collectives poorly).
+    """
+    # graftlint: disable=trace-env-read — direct-caller fallback only;
+    # sessions snapshot this via resolve_hist_knobs() at build time
+    return os.environ.get("GRAFT_HIST_OVERLAP", "1") == "1"
+
+
+def overlap_node_batches(num_nodes, enabled):
+    """Node-axis batching schedule for the pipelined level collective.
+
+    Returns the list of contiguous node slices whose histograms are
+    reduced (and gain-scanned) as independent collective -> scan chains.
+    With overlap disabled, or fewer than 2 nodes, the whole level is one
+    batch — the exact dataflow of the unpipelined path.
+    """
+    if not enabled or num_nodes < 2:
+        return [slice(0, num_nodes)]
+    half = num_nodes // 2
+    return [slice(0, half), slice(half, num_nodes)]
+
+
+def apply_hist_collective(G, H, axis_name, comm, axis_size):
+    """Reduce (G, H) level histograms across the data axis.
+
+    The collective tail of :func:`level_histogram`, split out so the
+    builders can issue it per node batch (overlap_node_batches): ``psum``
+    allreduces the full payload, ``reduce_scatter`` psum_scatters along the
+    feature dim (scatter_histograms). No-op when ``axis_name`` is None.
+    Reducing a node-axis slice is bit-identical to reducing the whole
+    level: both collectives sum the same per-node payloads in the same
+    shard order.
+    """
+    if axis_name is None:
+        return G, H
+    if comm == "reduce_scatter":
+        return scatter_histograms(G, H, axis_name, axis_size)
+    return jax.lax.psum(G, axis_name), jax.lax.psum(H, axis_name)
 
 
 def hist_comm_impl():
@@ -324,13 +376,7 @@ def level_histogram(
             "Unknown GRAFT_HIST_IMPL=%r; expected flat|per_feature|matmul|pallas"
             % impl
         )
-    if axis_name is not None:
-        if comm == "reduce_scatter":
-            G, H = scatter_histograms(G, H, axis_name, axis_size)
-        else:
-            G = jax.lax.psum(G, axis_name)
-            H = jax.lax.psum(H, axis_name)
-    return G, H
+    return apply_hist_collective(G, H, axis_name, comm, axis_size)
 
 
 def node_totals(grad, hess, node_local, num_nodes, axis_name=None, knobs=None):

@@ -20,11 +20,19 @@ inherent to static-shape leaf-wise growth without dynamic row partitions.
 import jax
 import jax.numpy as jnp
 
-from .histogram import level_histogram, padded_feature_width, subtraction_enabled
+from .histogram import (
+    _comm_overlap,
+    apply_hist_collective,
+    level_histogram,
+    overlap_node_batches,
+    padded_feature_width,
+    subtraction_enabled,
+)
 from .split import (
     broadcast_node_totals,
     column_shard_helpers,
     combine_splits_across_shards,
+    concat_node_splits,
     find_best_splits,
     leaf_weight,
     shard_feature_slice,
@@ -202,36 +210,67 @@ def build_tree_lossguide(
 
     node_of_row = jnp.zeros(n, jnp.int32)
 
-    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab, mask=None, GH=None):
-        """Histogram the two fresh children and return their candidates.
+    # pipelined step collectives (GRAFT_HIST_OVERLAP): without subtraction a
+    # split step reduces both fresh children's histograms — issuing one
+    # collective per child lets the second child's psum/psum_scatter fly
+    # while the first child's gain scan runs (the leaf-wise form of the
+    # depthwise level pipeline). The subtraction path has one collective
+    # per step (left child only) — nothing to overlap there.
+    overlap = (
+        (knobs.comm_overlap if knobs is not None else _comm_overlap())
+        and axis_name is not None
+    )
 
-        parent_rows_mask_nodes: node_local [n] mapping rows to {0,1,-1}.
-        GH: optional precomputed ([2, d, B], [2, d, B]) histograms (the
-        sibling-subtraction path).
-        """
-        if GH is not None:
-            G, H = GH
-        else:
-            G, H = level_histogram(
-                bins, grad, hess, parent_rows_mask_nodes, 2, num_bins,
-                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
-                knobs=knobs,
-            )
-        splits = find_best_splits(
-            G,
-            H,
+    def _scan_nodes(Gb, Hb, mask_b):
+        """Gain-scan + cross-shard combine for one node batch."""
+        s = find_best_splits(
+            Gb,
+            Hb,
             _scan_slice(num_cuts),
             reg_lambda=reg_lambda,
             alpha=alpha,
             gamma=gamma,
             min_child_weight=min_child_weight,
-            feature_mask=_scan_slice(mask if mask is not None else feature_mask),
+            feature_mask=_scan_slice(mask_b),
             monotone=_scan_slice(monotone),
-            totals=_scan_totals(G, H),
+            totals=_scan_totals(Gb, Hb),
         )
         # cross-shard combine: the candidate store (and therefore every
         # step's argmax) must be identical on all shards, with GLOBAL ids
-        splits = _combine(splits)
+        return _combine(s)
+
+    def _score_children(parent_rows_mask_nodes, id_a, id_b, depth_ab, mask=None, GH=None):
+        """Histogram the two fresh children and return their candidates.
+
+        parent_rows_mask_nodes: node_local [n] mapping rows to {0,1,-1}.
+        GH: optional precomputed ([2, d, B], [2, d, B]) histograms (the
+        sibling-subtraction path — already reduced, one batch).
+        """
+        mask = mask if mask is not None else feature_mask
+        if GH is not None:
+            batches = [(slice(0, 2),) + GH]
+        else:
+            G_loc, H_loc = level_histogram(
+                bins, grad, hess, parent_rows_mask_nodes, 2, num_bins,
+                knobs=knobs,
+            )
+            batches = [
+                (nsl,)
+                + apply_hist_collective(
+                    G_loc[nsl], H_loc[nsl], axis_name, hist_comm,
+                    n_data_shards,
+                )
+                for nsl in overlap_node_batches(2, overlap)
+            ]
+        splits = concat_node_splits(
+            [
+                _scan_nodes(
+                    Gb, Hb,
+                    mask[nsl] if mask is not None and mask.ndim == 2 else mask,
+                )
+                for nsl, Gb, Hb in batches
+            ]
+        )
         # depth cap: children at depth_cap can never split
         can_deepen = depth_ab < depth_cap
         gains = jnp.where(can_deepen, splits["gain"], -jnp.inf)
@@ -259,15 +298,7 @@ def build_tree_lossguide(
     if alive_sets is not None:
         allowed0 = _allowed_cols(alive_sets[0])
         root_mask = allowed0 if root_mask is None else root_mask * allowed0
-    root_splits = find_best_splits(
-        G, H, _scan_slice(num_cuts),
-        reg_lambda=reg_lambda, alpha=alpha, gamma=gamma,
-        min_child_weight=min_child_weight,
-        feature_mask=_scan_slice(root_mask),
-        monotone=_scan_slice(monotone),
-        totals=_scan_totals(G, H),
-    )
-    root_splits = _combine(root_splits)
+    root_splits = _scan_nodes(G, H, root_mask)
     cand["gain"] = cand["gain"].at[0].set(root_splits["gain"][0])
     cand["feature"] = cand["feature"].at[0].set(root_splits["feature"][0])
     cand["bin"] = cand["bin"].at[0].set(root_splits["bin"][0])

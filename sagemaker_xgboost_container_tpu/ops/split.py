@@ -67,6 +67,21 @@ def combine_splits_across_shards(splits, feat_shard, d_local, feature_axis_name)
     }
 
 
+def concat_node_splits(parts):
+    """Concatenate per-node-batch :func:`find_best_splits` results.
+
+    The gain scan is per-node independent, so scanning a level in node
+    batches (ops/histogram.overlap_node_batches — the pipelined-collective
+    schedule) and concatenating along the node axis is bit-identical to one
+    whole-level scan. A single batch passes through untouched.
+    """
+    if len(parts) == 1:
+        return parts[0]
+    return {
+        k: jnp.concatenate([p[k] for p in parts], axis=0) for k in parts[0]
+    }
+
+
 def broadcast_node_totals(G, H, shard, axis_name):
     """Per-node (sum g, sum h) for the reduce_scatter lowering.
 

@@ -19,8 +19,11 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import (
+    _comm_overlap,
+    apply_hist_collective,
     level_histogram,
     node_totals,
+    overlap_node_batches,
     padded_feature_width,
     subtraction_enabled,
 )
@@ -28,6 +31,7 @@ from .split import (
     broadcast_node_totals,
     column_shard_helpers,
     combine_splits_across_shards,
+    concat_node_splits,
     find_best_splits,
     leaf_weight,
     shard_feature_slice,
@@ -191,6 +195,16 @@ def build_tree(
     G_cache = H_cache = None      # previous level's [W/2, d_scan, B] histograms
     parent_leaf = None            # previous level's becomes_leaf [W/2]
 
+    # pipelined level collectives (GRAFT_HIST_OVERLAP): the node axis of a
+    # level splits into independent collective -> gain-scan batches, so the
+    # second batch's psum/psum_scatter is issued before the first batch's
+    # scan consumes its result — XLA can overlap wire time with compute.
+    # Per-node payloads reduce whole either way: bit-identical trees.
+    overlap = (
+        (knobs.comm_overlap if knobs is not None else _comm_overlap())
+        and axis_name is not None
+    )
+
     for level in range(max_depth + 1):
         first = 2**level - 1
         width = 2**level
@@ -220,30 +234,61 @@ def build_tree(
         if subtract and level > 0:
             # histogram only the LEFT child of each sibling pair; the right
             # one is parent - left. Parents that leafed routed no rows to
-            # their children, so their pair contribution is zeroed.
+            # their children, so their pair contribution is zeroed. The
+            # local accumulation runs ONCE over the rows; the collective is
+            # issued per node batch (overlap schedule) on slices of it.
             active = node_local >= 0
             is_left = (node_local % 2) == 0
             left_local = jnp.where(active & is_left, node_local // 2, -1)
-            Gl, Hl = level_histogram(
+            Gl_loc, Hl_loc = level_histogram(
                 bins, grad, hess, left_local, width // 2, num_bins,
-                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
                 knobs=knobs,
             )
             keep = ~parent_leaf
-            Gp = jnp.where(keep[:, None, None], G_cache, 0.0)
-            Hp = jnp.where(keep[:, None, None], H_cache, 0.0)
-            Gr = Gp - Gl
-            Hr = Hp - Hl
-            G = jnp.stack([Gl, Gr], axis=1).reshape(width, Gl.shape[1], -1)
-            H = jnp.stack([Hl, Hr], axis=1).reshape(width, Hl.shape[1], -1)
+
+            def _batch_hists(psl):
+                # parent slice [a, b) -> level nodes [2a, 2b), interleaved
+                # (left child 2i, right child 2i+1) from the reduced left
+                # histograms + the cached (already reduced) parent slice
+                Gl, Hl = apply_hist_collective(
+                    Gl_loc[psl], Hl_loc[psl], axis_name, hist_comm,
+                    n_data_shards,
+                )
+                kp = keep[psl]
+                Gp = jnp.where(kp[:, None, None], G_cache[psl], 0.0)
+                Hp = jnp.where(kp[:, None, None], H_cache[psl], 0.0)
+                Gr = Gp - Gl
+                Hr = Hp - Hl
+                Gb = jnp.stack([Gl, Gr], axis=1).reshape(
+                    2 * Gl.shape[0], Gl.shape[1], -1
+                )
+                Hb = jnp.stack([Hl, Hr], axis=1).reshape(
+                    2 * Hl.shape[0], Hl.shape[1], -1
+                )
+                return Gb, Hb
+
+            batch_hists = [
+                (slice(psl.start * 2, psl.stop * 2),) + _batch_hists(psl)
+                for psl in overlap_node_batches(width // 2, overlap)
+            ]
         else:
-            G, H = level_histogram(
-                bins, grad, hess, node_local, width, num_bins,
-                axis_name=axis_name, comm=hist_comm, axis_size=n_data_shards,
-                knobs=knobs,
+            G_loc, H_loc = level_histogram(
+                bins, grad, hess, node_local, width, num_bins, knobs=knobs,
             )
+            batch_hists = [
+                (nsl,)
+                + apply_hist_collective(
+                    G_loc[nsl], H_loc[nsl], axis_name, hist_comm,
+                    n_data_shards,
+                )
+                for nsl in overlap_node_batches(width, overlap)
+            ]
         if subtract:
-            G_cache, H_cache = G, H
+            if len(batch_hists) == 1:
+                G_cache, H_cache = batch_hists[0][1], batch_hists[0][2]
+            else:
+                G_cache = jnp.concatenate([b[1] for b in batch_hists], axis=0)
+                H_cache = jnp.concatenate([b[2] for b in batch_hists], axis=0)
         # shared column-draw convention (ops/split.py): draws over the REAL
         # global feature count, padded then sliced per shard
         d_draw, _pad_cols, _local_cols = column_shard_helpers(
@@ -279,46 +324,61 @@ def build_tree(
             ) > 0
             per_node = _local_cols(node_allowed.astype(jnp.float32))
             level_mask = per_node if level_mask is None else per_node * level_mask[None, :]
-        scan_cuts, scan_mask, scan_mono, scan_totals = (
-            num_cuts, level_mask, monotone, None,
-        )
-        if reduce_scatter:
-            # the scan sees only this shard's globally-summed feature slice;
-            # its per-feature inputs must slice exactly like the histograms,
-            # and node totals broadcast from shard 0 BEFORE the scan so
-            # every shard's gains use bit-identical totals
-            scan_cuts = shard_feature_slice(num_cuts, data_shard, d_scan, n_data_shards)
-            if scan_mask is not None:
-                scan_mask = shard_feature_slice(
-                    scan_mask, data_shard, d_scan, n_data_shards
-                )
-            if scan_mono is not None:
-                scan_mono = shard_feature_slice(
-                    scan_mono, data_shard, d_scan, n_data_shards
-                )
-            scan_totals = broadcast_node_totals(G, H, data_shard, axis_name)
-        splits = find_best_splits(
-            G,
-            H,
-            scan_cuts,
-            reg_lambda=reg_lambda,
-            alpha=alpha,
-            gamma=gamma,
-            min_child_weight=min_child_weight,
-            feature_mask=scan_mask,
-            monotone=scan_mono,
-            totals=scan_totals,
-        )
-        if reduce_scatter:
-            # the data axis is a feature axis for the duration of the scan:
-            # the same winner merge (totals pass through — already broadcast)
-            splits = combine_splits_across_shards(
-                splits, data_shard, d_scan, axis_name
+        def _scan_batch(nsl, Gb, Hb):
+            """Gain-scan one node batch of the level (per-node independent,
+            so batches concatenate bit-identically — concat_node_splits)."""
+            scan_cuts, scan_mask, scan_mono, scan_totals = (
+                num_cuts, level_mask, monotone, None,
             )
-        if feature_axis_name is not None:
-            splits = combine_splits_across_shards(
-                splits, feat_shard, d, feature_axis_name
+            if scan_mask is not None and scan_mask.ndim == 2:
+                scan_mask = scan_mask[nsl]  # per-node mask rows
+            if reduce_scatter:
+                # the scan sees only this shard's globally-summed feature
+                # slice; its per-feature inputs must slice exactly like the
+                # histograms, and node totals broadcast from shard 0 BEFORE
+                # the scan so every shard's gains use bit-identical totals
+                scan_cuts = shard_feature_slice(
+                    num_cuts, data_shard, d_scan, n_data_shards
+                )
+                if scan_mask is not None:
+                    scan_mask = shard_feature_slice(
+                        scan_mask, data_shard, d_scan, n_data_shards
+                    )
+                if scan_mono is not None:
+                    scan_mono = shard_feature_slice(
+                        scan_mono, data_shard, d_scan, n_data_shards
+                    )
+                scan_totals = broadcast_node_totals(
+                    Gb, Hb, data_shard, axis_name
+                )
+            s = find_best_splits(
+                Gb,
+                Hb,
+                scan_cuts,
+                reg_lambda=reg_lambda,
+                alpha=alpha,
+                gamma=gamma,
+                min_child_weight=min_child_weight,
+                feature_mask=scan_mask,
+                monotone=scan_mono,
+                totals=scan_totals,
             )
+            if reduce_scatter:
+                # the data axis is a feature axis for the duration of the
+                # scan: the same winner merge (totals pass through —
+                # already broadcast)
+                s = combine_splits_across_shards(
+                    s, data_shard, d_scan, axis_name
+                )
+            if feature_axis_name is not None:
+                s = combine_splits_across_shards(
+                    s, feat_shard, d, feature_axis_name
+                )
+            return s
+
+        splits = concat_node_splits(
+            [_scan_batch(nsl, Gb, Hb) for nsl, Gb, Hb in batch_hists]
+        )
 
         g_tot, h_tot = splits["g_total"], splits["h_total"]
         weight = leaf_weight(

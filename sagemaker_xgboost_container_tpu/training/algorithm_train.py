@@ -421,10 +421,11 @@ def train_job(
 
     # Default to batching several boosting rounds per device dispatch when no
     # per-round host artifact is required (checkpoint files / intermediate
-    # model saves must land every round for spot safety). The booster itself
-    # falls back to K=1 whenever per-round metrics can't ride back from the
-    # device (validation sets with separate margins, feval, AUC-style
-    # metrics). Explicit _rounds_per_dispatch always wins.
+    # model saves must land every round for spot safety). Metrics that can't
+    # ride back from the device (feval, ranking metrics) no longer force
+    # K=1: the booster keeps the fused dispatch and host-evaluates once per
+    # K rounds (docs/DESIGN.md §Round pipeline). Explicit
+    # _rounds_per_dispatch always wins.
     if (
         not checkpoint_dir
         and save_model_on_termination != "true"

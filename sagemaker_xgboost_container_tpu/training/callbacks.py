@@ -56,14 +56,26 @@ class _TimedCallback:
 
 
 class EvaluationMonitor:
-    """Print one stdout line per round in xgboost's format."""
+    """Print one stdout line per round in xgboost's format.
+
+    Under the fused-dispatch host-fallback cadence (metric lines land once
+    per K-round dispatch — models/booster.py) rounds between dispatches add
+    no fresh entries; printing the stale previous values against a new
+    round index would misreport, so those rounds print nothing.
+    """
+
+    def __init__(self):
+        self._entries_seen = 0
 
     def after_iteration(self, model, epoch, evals_log):
         parts = []
+        total = 0
         for data_name, metrics in evals_log.items():
             for metric_name, values in metrics.items():
+                total += len(values)
                 parts.append("{}-{}:{:.5f}".format(data_name, metric_name, values[-1]))
-        if parts:
+        if parts and total != self._entries_seen:
+            self._entries_seen = total
             print("[{}]\t{}".format(epoch, "\t".join(parts)), flush=True)
         return False
 
@@ -84,6 +96,7 @@ class EarlyStopping:
         self.best_score = None
         self.best_iteration = 0
         self.stagnation = 0
+        self._entries_seen = 0
 
     def _improved(self, score):
         if self.best_score is None:
@@ -94,13 +107,23 @@ class EarlyStopping:
         series = evals_log.get(self.data_name, {}).get(self.metric_name)
         if not series:
             return False
+        if len(series) == self._entries_seen:
+            # no fresh metric this round: the fused-dispatch host-fallback
+            # cadence evaluates once per K rounds — a stale repeat carries
+            # no evidence, so no stop decision is made here
+            return False
+        self._entries_seen = len(series)
         score = series[-1]
         if self._improved(score):
             self.best_score = score
             self.best_iteration = epoch
             self.stagnation = 0
             return False
-        self.stagnation += 1
+        # patience is measured in boosting ROUNDS since the best iteration,
+        # not in fresh metric entries: under the once-per-K-rounds cadence
+        # counting entries would silently multiply early_stopping_rounds by
+        # K. Equivalent to the entry count when every round has an entry.
+        self.stagnation = epoch - self.best_iteration
         return self.stagnation >= self.rounds
 
     def after_training(self, model):

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Fused-dispatch smoke: K=1 vs K=4 on a tiny synthetic task, bounded.
+
+Two claims of the fused round pipeline (docs/DESIGN.md §Round pipeline) are
+cheap to verify on every CI run and expensive to discover broken later:
+
+* **bit-identity** — committed trees and predictions under
+  ``_rounds_per_dispatch=4`` are u32-view identical to the K=1 synchronous
+  path (the contract every perf change must keep);
+* **not slower** — fusing K rounds into one ``lax.scan`` dispatch amortizes
+  the per-round Python + dispatch overhead, so the fused per-round wall time
+  must not exceed the K=1 time by more than ``BENCH_SMOKE_TOL`` (default
+  1.35 — a guardrail against the scan path regressing into re-compiles or
+  extra transfers, not a microbenchmark).
+
+Sized to stay well under 60 s on the CI CPU (tiny rows, shallow trees,
+single measurement window after a compile warmup). The measured numbers are
+archived as JSON under the argv[1] directory (``ci.sh`` passes
+``${CI_ARTIFACT_DIR:-.ci-artifacts}/bench``).
+
+Exit codes: 0 OK, 1 bit-identity or speed assertion failed.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+N_ROWS = int(os.environ.get("BENCH_SMOKE_ROWS", "20000"))
+N_FEATURES = 8
+MAX_DEPTH = 4
+MEASURE_ROUNDS = 12
+TOL = float(os.environ.get("BENCH_SMOKE_TOL", "1.35"))
+
+
+def _session(dtrain, k):
+    from sagemaker_xgboost_container_tpu.models.booster import (
+        TrainConfig,
+        _TrainingSession,
+    )
+    from sagemaker_xgboost_container_tpu.models.forest import Forest
+
+    cfg = TrainConfig(
+        {
+            "objective": "binary:logistic",
+            "max_depth": MAX_DEPTH,
+            "max_bin": 64,
+            "_rounds_per_dispatch": k,
+        }
+    )
+    forest = Forest(
+        objective_name=cfg.objective,
+        base_score=cfg.base_score,
+        num_feature=dtrain.num_col,
+    )
+    return _TrainingSession(cfg, dtrain, [], forest)
+
+
+def _rate(session):
+    """Measured per-round wall seconds after a compile warmup dispatch."""
+    import jax
+
+    session.run_rounds()  # compile + warm
+    jax.block_until_ready(session.margins)
+    done = 0
+    t0 = time.perf_counter()
+    while done < MEASURE_ROUNDS:
+        done += len(session.run_rounds()[0])
+        jax.block_until_ready(session.margins)
+    return (time.perf_counter() - t0) / done
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_dir = argv[0] if argv else os.path.join(".ci-artifacts", "bench")
+
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(N_ROWS, N_FEATURES).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+
+    # --- bit-identity: K=1 vs K=4 committed forests -----------------------
+    params = {"objective": "binary:logistic", "max_depth": MAX_DEPTH,
+              "max_bin": 64, "seed": 7}
+    f1 = train(dict(params), dtrain, num_boost_round=4)
+    f4 = train(dict(params, _rounds_per_dispatch=4), dtrain, num_boost_round=4)
+    p1 = np.asarray(f1.predict(X), np.float32)
+    p4 = np.asarray(f4.predict(X), np.float32)
+    bitwise = bool(np.array_equal(p1.view(np.uint32), p4.view(np.uint32)))
+
+    # --- throughput: fused dispatch must not be slower --------------------
+    s_k1 = _rate(_session(dtrain, 1))
+    s_k4 = _rate(_session(dtrain, 4))
+
+    doc = {
+        "rows": N_ROWS,
+        "measure_rounds": MEASURE_ROUNDS,
+        "k1_round_s": round(s_k1, 6),
+        "k4_round_s": round(s_k4, 6),
+        "k4_speedup": round(s_k1 / max(s_k4, 1e-9), 3),
+        "tolerance": TOL,
+        "bitwise_identical": bitwise,
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "bench_smoke.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    sys.stderr.write("bench smoke: {}\n".format(json.dumps(doc)))
+
+    if not bitwise:
+        sys.stderr.write(
+            "bench smoke FAILED: K=4 trees/predictions diverge bitwise "
+            "from K=1\n"
+        )
+        return 1
+    if s_k4 > s_k1 * TOL:
+        sys.stderr.write(
+            "bench smoke FAILED: fused K=4 dispatch is slower than K=1 "
+            "({:.4f}s vs {:.4f}s per round, tol {}x)\n".format(s_k4, s_k1, TOL)
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

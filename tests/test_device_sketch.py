@@ -237,15 +237,19 @@ def test_device_kernels_do_not_recompile_across_calls(monkeypatch):
 def test_approx_resketch_forces_single_round_dispatch(monkeypatch, caplog):
     """ADVICE r5: with _rounds_per_dispatch > 1 the approx re-sketch would
     refresh candidates once per K-round dispatch, not once per boosting
-    iteration as libxgboost's approx does. The session forces K=1 (logged);
+    iteration as libxgboost's approx does. The session forces K=1, WARNED
+    ONCE per process (a CV fold / elastic rebuild must not re-log);
     GRAFT_APPROX_RESKETCH=0 restores batched dispatches."""
     import logging
 
     from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import booster as booster_mod
     from sagemaker_xgboost_container_tpu.models.booster import (
         TrainConfig, _TrainingSession,
     )
     from sagemaker_xgboost_container_tpu.models.forest import Forest
+
+    monkeypatch.setattr(booster_mod, "_approx_k_forcing_warned", False)
 
     rng = np.random.RandomState(3)
     X = rng.randn(256, 4).astype(np.float32)
@@ -267,7 +271,21 @@ def test_approx_resketch_forces_single_round_dispatch(monkeypatch, caplog):
         session = _session()
     assert session.approx_resketch
     assert session.rounds_per_dispatch == 1
-    assert any("_rounds_per_dispatch" in r.message for r in caplog.records)
+    forcing_logs = [
+        r for r in caplog.records if "_rounds_per_dispatch" in r.message
+    ]
+    assert len(forcing_logs) == 1
+    assert forcing_logs[0].levelno == logging.WARNING
+
+    # warn-once: a rebuilt session (CV fold / elastic reform) still forces
+    # K=1 but adds no second log line
+    with caplog.at_level(logging.INFO):
+        again = _session()
+    assert again.rounds_per_dispatch == 1
+    assert (
+        len([r for r in caplog.records if "_rounds_per_dispatch" in r.message])
+        == 1
+    )
 
     monkeypatch.setenv("GRAFT_APPROX_RESKETCH", "0")
     session2 = _session()

@@ -97,6 +97,40 @@ def test_trace_env_read_follows_call_graph_and_spares_unreachable(tmp_path):
     assert "GRAFT_DEEP" in report.findings[0].message
 
 
+def test_trace_pass_reaches_double_buffered_builder_helpers(tmp_path):
+    """The fused round pipeline (ops/tree_build, ops/lossguide) routes
+    histograms through helpers invoked from comprehensions and nested
+    per-batch closures — apply_hist_collective per node batch, a _scan_batch
+    closure per slice. The name-based call graph must keep treating that
+    shape as jit-reachable so trace-env-read / trace-host-sync still cover
+    the hot path."""
+    root = make_tree(tmp_path, {"mod.py": """\
+        import os
+        import jax
+
+        def apply_collective(g):
+            # BAD: env read on the traced path, reached via comprehension
+            return g * int(os.environ.get("GRAFT_COMM_KNOB", "1"))
+
+        def scan_batch(g):
+            # BAD: host sync on the traced path, reached via nested closure
+            return g.item()
+
+        def build_tree(gs):
+            batches = [apply_collective(g) for g in gs]
+
+            def _batch(g):
+                return scan_batch(g)
+
+            return [_batch(g) for g in batches]
+
+        round_fn = jax.jit(build_tree)
+        """})
+    report = run_rules(root, "trace-env-read", "trace-host-sync")
+    assert rule_set(report) == {"trace-env-read", "trace-host-sync"}
+    assert any("GRAFT_COMM_KNOB" in f.message for f in report.findings)
+
+
 def test_trace_env_read_envconfig_helper_definition_exempt(tmp_path):
     # the call SITE is the policy surface: a traced caller of env_int is
     # flagged, but the helper's own os.getenv body is not — otherwise every

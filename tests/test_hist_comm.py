@@ -90,6 +90,123 @@ def _train_both(monkeypatch, params, X, y, mesh, rounds=4, extra_env=()):
 
 
 @pytest.mark.multichip
+def test_k_round_equivalence_matrix(monkeypatch, mesh8):
+    """Fused-dispatch equivalence matrix: K∈{1,4} x {psum, reduce_scatter}
+    x {hist, lossguide} x subtraction on/off — committed trees AND
+    predictions must be u32-view identical to the K=1 psum reference of the
+    same (builder, subtraction) cell. This is the bit-identity contract the
+    fused round pipeline (K-round lax.scan + overlapped collectives +
+    donated round state) must keep."""
+    X, y = _data(n=512, d=9, seed=11)
+    builder_params = {
+        "hist": {"objective": "binary:logistic", "max_depth": 3, "seed": 4},
+        "lossguide": {
+            "objective": "binary:logistic",
+            "grow_policy": "lossguide",
+            "max_leaves": 6,
+            "max_depth": 0,
+            "seed": 4,
+        },
+    }
+    for builder, params in builder_params.items():
+        for subtract in ("1", "0"):
+            monkeypatch.setenv("GRAFT_HIST_SUBTRACT", subtract)
+            reference = None
+            for comm in ("psum", "reduce_scatter"):
+                monkeypatch.setenv("GRAFT_HIST_COMM", comm)
+                for k_rounds in (1, 4):
+                    f = train(
+                        dict(params, _rounds_per_dispatch=k_rounds),
+                        DataMatrix(X, labels=y),
+                        num_boost_round=4,
+                        mesh=mesh8,
+                    )
+                    assert f.num_boosted_rounds == 4
+                    if reference is None:
+                        reference = f
+                        continue
+                    cell = (builder, subtract, comm, k_rounds)
+                    _assert_forests_bitwise(reference, f)
+                    pr = np.asarray(reference.predict(X), np.float32)
+                    pf = np.asarray(f.predict(X), np.float32)
+                    assert np.array_equal(
+                        pr.view(np.uint32), pf.view(np.uint32)
+                    ), cell
+
+
+@pytest.mark.multichip
+def test_overlap_knob_bitwise_and_single_batch(monkeypatch, mesh8):
+    """GRAFT_HIST_OVERLAP=0 (single fused per-level collective) commits the
+    same bits as the default pipelined schedule, and the schedule helper
+    degenerates to one whole-level batch when disabled."""
+    from sagemaker_xgboost_container_tpu.ops.histogram import (
+        overlap_node_batches,
+    )
+
+    assert overlap_node_batches(8, False) == [slice(0, 8)]
+    assert overlap_node_batches(1, True) == [slice(0, 1)]
+    assert overlap_node_batches(8, True) == [slice(0, 4), slice(4, 8)]
+
+    X, y = _data(n=512, d=11, seed=12)
+    params = {"objective": "binary:logistic", "max_depth": 4, "seed": 2}
+    forests = []
+    for ov in ("1", "0"):
+        monkeypatch.setenv("GRAFT_HIST_OVERLAP", ov)
+        monkeypatch.setenv("GRAFT_HIST_COMM", "reduce_scatter")
+        forests.append(
+            train(dict(params), DataMatrix(X, labels=y), num_boost_round=3,
+                  mesh=mesh8)
+        )
+    _assert_forests_bitwise(*forests)
+
+
+def test_scan_carry_donation_reuses_round_buffers():
+    """Round-state donation: the fused dispatch donates the margin carry
+    (and the eval-margin carry), so round N+1 writes into round N's
+    buffers instead of allocating. Asserted via unsafe_buffer_pointer on
+    backends whose runtime implements input-output aliasing; skipped where
+    donation is advisory."""
+    from sagemaker_xgboost_container_tpu.models.booster import (
+        TrainConfig,
+        _TrainingSession,
+    )
+    from sagemaker_xgboost_container_tpu.models.forest import Forest
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(600, 5).astype(np.float32)
+    y = (X[:, 0] > 0.4).astype(np.float32)
+    Xv = rng.rand(128, 5).astype(np.float32)
+    yv = (Xv[:, 0] > 0.4).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    dval = DataMatrix(Xv, labels=yv)
+    cfg = TrainConfig(
+        {"objective": "binary:logistic", "max_depth": 3,
+         "_rounds_per_dispatch": 3, "eval_metric": "logloss"}
+    )
+    forest = Forest(
+        objective_name=cfg.objective, base_score=cfg.base_score, num_feature=5
+    )
+    session = _TrainingSession(
+        cfg, dtrain, [(dval, "validation")], forest,
+        metric_names=["logloss"],
+    )
+    assert session.use_scan_rounds and session.rounds_per_dispatch == 3
+    session.run_rounds()  # compile + first allocation
+    try:
+        margin_ptr = session.margins.unsafe_buffer_pointer()
+        eval_ptr = session.eval_margins[0].unsafe_buffer_pointer()
+    except (AttributeError, NotImplementedError):
+        pytest.skip("backend exposes no unsafe_buffer_pointer")
+    session.run_rounds()
+    if session.margins.unsafe_buffer_pointer() != margin_ptr:
+        pytest.skip("backend does not alias donated round buffers")
+    # train margins AND the scanned eval-margin carry both reuse their
+    # donated buffers across dispatches
+    assert session.margins.unsafe_buffer_pointer() == margin_ptr
+    assert session.eval_margins[0].unsafe_buffer_pointer() == eval_ptr
+
+
+@pytest.mark.multichip
 def test_reduce_scatter_bitwise_depthwise(monkeypatch, mesh8):
     # d=11 does not divide 8: features pad to 16, 2 per shard, the last
     # shard scanning pure padding — which must never win a split

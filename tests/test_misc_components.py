@@ -94,6 +94,107 @@ def test_rounds_per_dispatch_falls_back_with_evals():
     assert len(log["train"]["rmse"]) == 4
 
 
+def test_host_fallback_metrics_every_k_rounds():
+    """Metrics outside the device set (a feval here) no longer force the
+    fused dispatch back to K=1: the scan keeps K, eval margins ride the
+    carry, and host metric lines land once per dispatch at the batch-end
+    round — with a committed-forest correction when the final batch
+    over-builds (num_boost_round % K != 0)."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(400, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    dtrain = DataMatrix(X, labels=y)
+    dval = DataMatrix(X[:100], labels=y[:100])
+
+    def feval(margin, dm):
+        return [("absmargin", float(np.mean(np.abs(margin))))]
+
+    log = {}
+    epochs = []
+
+    class Recorder:
+        def after_iteration(self, model, epoch, evals_log):
+            fresh = sum(len(v) for d in evals_log.values() for v in d.values())
+            if fresh != getattr(self, "_seen", 0):
+                self._seen = fresh
+                epochs.append(epoch)
+            log.update(
+                {k: {m: list(v) for m, v in d.items()} for k, d in evals_log.items()}
+            )
+            return False
+
+    forest = train(
+        {"objective": "binary:logistic", "max_depth": 3,
+         "_rounds_per_dispatch": 4, "eval_metric": "auc"},
+        dtrain,
+        num_boost_round=6,
+        evals=[(dtrain, "train"), (dval, "validation")],
+        callbacks=[Recorder()],
+        feval=feval,
+    )
+    assert forest.num_boosted_rounds == 6
+    # one metric line per dispatch: the full batch ends at round 3, the
+    # truncated final batch reports at round 5 (the last committed round)
+    assert epochs == [3, 5]
+    assert len(log["train"]["absmargin"]) == 2
+    assert len(log["validation"]["auc"]) == 2
+    # the truncated batch's final line comes from the COMMITTED forest, not
+    # the over-built device margins (2 trees were discarded)
+    committed_margin = np.asarray(forest.predict(X, output_margin=True))
+    assert abs(
+        log["train"]["absmargin"][-1] - float(np.mean(np.abs(committed_margin)))
+    ) < 1e-6
+
+
+def test_host_fallback_early_stopping_counts_rounds_not_entries():
+    """EarlyStopping under the once-per-dispatch cadence: stale rounds make
+    no stop decision, and patience is measured in boosting ROUNDS since the
+    best iteration — counting fresh entries would multiply
+    early_stopping_rounds by K, stale repeats would divide it by K."""
+    from sagemaker_xgboost_container_tpu.training.callbacks import EarlyStopping
+
+    es = EarlyStopping(rounds=6, data_name="train", metric_name="rmse",
+                       maximize=False)
+    evals_log = {"train": {"rmse": [1.0]}}
+    assert not es.after_iteration(None, 0, evals_log)
+    # 3 stale rounds inside the fused batch: no stagnation accrued
+    for epoch in (1, 2, 3):
+        assert not es.after_iteration(None, epoch, evals_log)
+    assert es.stagnation == 0
+    evals_log["train"]["rmse"].append(1.5)  # worse at the next batch end
+    assert not es.after_iteration(None, 4, evals_log)
+    assert es.stagnation == 4  # 4 rounds since best (round 0), patience 6
+    evals_log["train"]["rmse"].append(1.6)  # still worse at round 8
+    assert es.after_iteration(None, 8, evals_log)  # 8 rounds >= patience 6
+    # per-round cadence is unchanged: rounds-since-best == entry count
+    es2 = EarlyStopping(rounds=2, data_name="train", metric_name="rmse",
+                        maximize=False)
+    log2 = {"train": {"rmse": [1.0]}}
+    assert not es2.after_iteration(None, 0, log2)
+    log2["train"]["rmse"].append(1.1)
+    assert not es2.after_iteration(None, 1, log2)
+    log2["train"]["rmse"].append(1.2)
+    assert es2.after_iteration(None, 2, log2)
+
+
+def test_evaluation_monitor_skips_stale_rounds(capsys):
+    """EvaluationMonitor prints only rounds that produced fresh entries —
+    stale values against a new round index would misreport under the
+    fused-dispatch cadence."""
+    from sagemaker_xgboost_container_tpu.training.callbacks import (
+        EvaluationMonitor,
+    )
+
+    mon = EvaluationMonitor()
+    evals_log = {"train": {"rmse": [0.5]}}
+    mon.after_iteration(None, 0, evals_log)
+    mon.after_iteration(None, 1, evals_log)  # stale: nothing printed
+    evals_log["train"]["rmse"].append(0.4)
+    mon.after_iteration(None, 2, evals_log)
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == ["[0]\ttrain-rmse:0.50000", "[2]\ttrain-rmse:0.40000"]
+
+
 def test_algorithm_handler_service(tmp_path):
     rng = np.random.RandomState(2)
     X = rng.rand(200, 3).astype(np.float32)

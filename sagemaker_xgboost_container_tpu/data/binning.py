@@ -31,15 +31,28 @@ from ..toolkit import exceptions as exc
 
 
 class BinnedMatrix:
-    """Bin-index features + cut points + labels/weights/groups."""
+    """Bin-index features + cut points + labels/weights/groups.
 
-    def __init__(self, bins, cut_points, max_bin, labels=None, weights=None, groups=None):
+    Also accepted directly by ``models/booster.train`` as a *pre-binned*
+    training/eval input (the streaming-ingest plane in ``data/streaming.py``
+    produces one without ever materializing the float32 channel): the
+    session then skips its own sketch+bin stage and trusts these cuts.
+    Pre-binned matrices deliberately have no ``.features`` — anything that
+    genuinely needs floats goes through ``rep_block`` (bounded blocks of
+    representative values whose tree routing is bit-identical to the
+    original floats) so no code path can silently rehydrate the whole
+    dataset.
+    """
+
+    def __init__(self, bins, cut_points, max_bin, labels=None, weights=None,
+                 groups=None, feature_names=None):
         self.bins = bins                  # uint8/uint16 [n, d]; max_bin == missing
         self.cut_points = cut_points      # list of d float32 ascending arrays
         self.max_bin = int(max_bin)       # missing-bin index; num_bins = max_bin + 1
         self.labels = labels
         self.weights = weights
         self.groups = groups
+        self.feature_names = list(feature_names) if feature_names is not None else None
 
     @property
     def num_row(self):
@@ -52,6 +65,56 @@ class BinnedMatrix:
     @property
     def num_bins(self):
         return self.max_bin + 1
+
+    def get_label(self):
+        return self.labels if self.labels is not None else np.empty(0, dtype=np.float32)
+
+    def get_weight(self):
+        if self.weights is None:
+            return np.ones(self.num_row, dtype=np.float32)
+        return self.weights
+
+    @property
+    def features(self):
+        # loud guard: a pre-binned matrix reaching a float-features consumer
+        # is a wiring bug (the caller should be gated off the chunked path
+        # or use rep_block) — never silently hand out representative values
+        # where code expects the original floats
+        raise exc.AlgorithmError(
+            "BinnedMatrix has no float features (chunked ingest never "
+            "materializes the channel); use rep_block() for routing-exact "
+            "representative values or gate this path off pre-binned input"
+        )
+
+    def rep_block(self, start, end):
+        """Representative float rows ``[start:end)`` (routing-exact).
+
+        Every committed split threshold is drawn from ``cut_points`` (cuts
+        ARE the serialized ``split_condition`` values), and for any value v
+        in bin b the decision ``v < cut[i]`` holds iff ``b <= i``. The
+        representative for bin b >= 1 is ``cut[b-1]`` (and just below
+        ``cut[0]`` for bin 0, NaN for the missing bin), which satisfies the
+        same equivalence — so predictions computed from representative
+        blocks are bit-identical to predictions from the original floats
+        (leaf routing identical, identical leaf values summed in the same
+        order). Used for warm-start margins and host-side eval on
+        pre-binned matrices, one bounded block at a time.
+        """
+        bins = self.bins[start:end]
+        out = np.empty(bins.shape, np.float32)
+        for f in range(self.num_col):
+            cuts = np.asarray(self.cut_points[f], np.float32)
+            lookup = np.full(self.max_bin + 1, np.nan, np.float32)
+            if cuts.size:
+                # both args float32: nextafter(f32, python-float) promotes to
+                # float64 on pre-NEP50 numpy and rounds back to cuts[0] when
+                # stored, putting bin 0 on the wrong side of `v < cut[0]`
+                lookup[0] = np.nextafter(cuts[0], np.float32(-np.inf))
+                lookup[1 : cuts.size + 1] = cuts
+            else:
+                lookup[0] = 0.0  # no cuts -> never split on; value is inert
+            out[:, f] = lookup[bins[:, f]]
+        return out
 
 
 def _select_cuts(sorted_values, sorted_weights, max_cuts):
@@ -252,6 +315,36 @@ def compute_cut_points(features, weights=None, max_bin=256):
         valid = ~np.isnan(col)
         cuts.append(_select_cuts(col[valid], colw[valid], max_cuts))
     return cuts
+
+
+def cuts_from_summaries(summaries, max_bin):
+    """Per-feature cuts from merged (distinct values, weight sums) summaries.
+
+    ``summaries``: one ``(values, weights)`` pair per feature — values
+    strictly ascending f32 distinct feature values, weights the total sketch
+    weight observed at each value (the streaming-ingest sketch merge,
+    ``data/streaming.py``). Runs the exact ``_select_cuts`` host kernel:
+    ``np.unique`` over already-distinct values is the identity, so the
+    cumulative weight at each distinct run end equals ``cumsum(weights)``
+    — for unit (and integer, up to f32-exact range) row weights the
+    selected cuts are **bitwise identical** to ``compute_cut_points`` over
+    the flat float channel. Arbitrary float row weights can differ in the
+    last ulp of a cumulative sum (chunk-partitioned summation order), which
+    can shift a razor-edge quantile pick by one distinct value — the same
+    class (and magnitude) of caveat the device sketch lowering documents.
+    """
+    if max_bin is None:
+        raise exc.UserError(
+            "tree_method='exact' (max_bin=None) is not supported by chunked "
+            "ingest; use tree_method='hist' or SM_INGEST_MODE=whole."
+        )
+    max_cuts = max_bin - 1
+    return [
+        _select_cuts(
+            np.asarray(values, np.float32), np.asarray(weights, np.float32), max_cuts
+        )
+        for values, weights in summaries
+    ]
 
 
 def apply_cut_points(features, cut_points, max_bin):

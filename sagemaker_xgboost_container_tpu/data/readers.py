@@ -127,13 +127,62 @@ def stage_input_files(data_path, staging_dir=STAGING_DIR):
 
 _SIDECAR_SUFFIXES = (".group", ".weight")
 
+def _skip_empty_files(files, count=True):
+    """Drop zero-byte files from a channel listing (all four formats).
+
+    A zero-byte file used to surface as a raw ``pandas.errors.EmptyDataError``
+    (csv), a phantom empty part (libsvm/recordio) or a pyarrow parse error —
+    none of which name the real problem. Skipped files warn once per process
+    and count in ``ingest_files_empty_total`` — only when ``count`` (the
+    validation pre-pass passes ``count=False`` so a file skipped there and
+    again by the reader's own listing is one metric increment, not two).
+    """
+    from ..utils.warn_once import warn_once
+
+    kept, empty = [], []
+    for f in files:
+        try:
+            size = os.path.getsize(os.path.realpath(f))
+        except OSError:
+            size = -1  # unreadable: leave it for the reader's retry policy
+        (empty if size == 0 else kept).append(f)
+    if empty:
+        if count:
+            from ..telemetry.registry import REGISTRY
+
+            REGISTRY.counter(
+                "ingest_files_empty_total",
+                "Zero-byte channel files skipped during ingest",
+            ).inc(len(empty))
+        warn_once(
+            logger, "ingest.empty_files",
+            "skipping %d zero-byte file(s) in the channel (first: %s); "
+            "further empty files are counted in ingest_files_empty_total "
+            "without logging",
+            len(empty),
+            os.path.basename(os.path.realpath(empty[0])),
+        )
+    return kept
+
 
 def _list_data_files(path):
     if os.path.isfile(path):
-        return [path]
+        return _skip_empty_files([path])
+    # sort by the link TARGET first: staged symlink names carry a per-process
+    # salted hash() suffix, so sorting the staged names alone is not
+    # deterministic across hosts/reruns — and chunk assignment (and therefore
+    # row order) must be deterministic across hosts for the chunk plans to
+    # agree (data/streaming.py exits 85 on plan divergence)
     files = sorted(
-        os.path.join(path, f) for f in os.listdir(path) if _is_data_file(path, f)
+        (os.path.join(path, f) for f in os.listdir(path) if _is_data_file(path, f)),
+        key=lambda f: (os.path.realpath(f), f),
     )
+    # pair sidecars against the FULL listing (before empty files are
+    # dropped): a zero-byte data file must still claim its .weight/.group
+    # companion, or the orphaned sidecar would be returned as a data file
+    # and silently parsed as label-only libsvm rows
+    all_files = list(files)
+    files = _skip_empty_files(files)
     # sidecar group/weight files ride along with their data file; don't parse
     # them as data (staged links carry a hash suffix, so match on the target)
     out = []
@@ -145,7 +194,7 @@ def _list_data_files(path):
                 if base.endswith(s):
                     base = base[: -len(s)]
                     break
-            if any(os.path.realpath(g) == base for g in files if g != f):
+            if any(os.path.realpath(g) == base for g in all_files if g != f):
                 continue
         out.append(f)
     return out
@@ -227,16 +276,22 @@ def validate_data_file_path(data_path, content_type):
     if os.path.isfile(data_path):
         files = [data_path]
     else:
+        # deterministic: os.walk visits dirs in listdir order (filesystem-
+        # dependent) — sort the traversal so "first leaf dir" and the file
+        # list are identical across hosts/filesystems (file order decides
+        # row order and chunk assignment downstream)
         leaf_dir = None
         for root, dirs, _files in os.walk(data_path):
+            dirs.sort()
             if not dirs:
                 leaf_dir = root
                 break
-        files = [
+        files = sorted(
             os.path.join(leaf_dir, f)
             for f in os.listdir(leaf_dir)
             if _is_data_file(leaf_dir, f)
-        ]
+        )
+    files = _skip_empty_files(files, count=False)  # the reader's own listing counts them
     if parsed == ct.CSV:
         for f in files:
             _validate_csv_file(f)
@@ -251,6 +306,45 @@ def validate_data_file_path(data_path, content_type):
 # ---------------------------------------------------------------------------
 
 
+def _first_line(p):
+    with open(p, "r", errors="ignore") as f:
+        return f.readline()
+
+
+def _channel_delimiter(files, site="reader.csv"):
+    """Sniff the CSV delimiter from the first file and validate it against
+    the first line of every other file.
+
+    The delimiter used to be sniffed from the first file only: a channel
+    mixing comma- and semicolon-delimited parts parsed the odd file out as
+    single garbage columns (or NaN-widened the frame) with no hint of which
+    file was wrong. A file whose own sniff disagrees now raises a
+    ``UserError`` naming it; a file whose first line is un-sniffable (e.g. a
+    single column) is left for the parser, which reports it with context.
+    """
+    delimiter = _sniff_csv_delimiter(
+        _read_with_retries(lambda: _first_line(files[0]), files[0], site)
+    )
+    for f in files[1:]:
+        line = _read_with_retries(lambda f=f: _first_line(f), f, site)
+        try:
+            found = _sniff_csv_delimiter(line)
+        except exc.UserError:
+            continue  # un-sniffable line: the parser names it on failure
+        if found != delimiter:
+            raise exc.UserError(
+                "CSV delimiter mismatch in channel: file '{}' uses {!r} but "
+                "'{}' (the first file) uses {!r}. All files of one channel "
+                "must share a delimiter.".format(
+                    os.path.basename(os.path.realpath(f)),
+                    found,
+                    os.path.basename(os.path.realpath(files[0])),
+                    delimiter,
+                )
+            )
+    return delimiter
+
+
 def _read_csv_files(path, csv_weights=0):
     import pandas as pd
 
@@ -258,13 +352,7 @@ def _read_csv_files(path, csv_weights=0):
     if not files:
         return None
 
-    def _first_line(p):
-        with open(p, "r", errors="ignore") as f:
-            return f.readline()
-
-    delimiter = _sniff_csv_delimiter(
-        _read_with_retries(lambda: _first_line(files[0]), files[0], "reader.csv")
-    )
+    delimiter = _channel_delimiter(files)
     frames = [
         _read_with_retries(
             lambda f=f: pd.read_csv(f, header=None, delimiter=delimiter, dtype=np.float32),

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..data.binning import bin_matrix
+from ..data.binning import BinnedMatrix, bin_matrix
 from ..ops.histogram import (
     hist_comm_impl,
     padded_feature_width,
@@ -179,6 +179,31 @@ def _eval_metric_names(config, objective):
     elif isinstance(metrics, str):
         metrics = [metrics]
     return list(metrics)
+
+
+def _predict_margin_rows(forest, dm, block_rows=1 << 16):
+    """``forest.predict_margin`` over a data/eval matrix's rows.
+
+    DataMatrix inputs predict from their float features as always. Pre-binned
+    inputs (chunked streaming ingest — the float channel was never
+    materialized) predict from bounded blocks of *representative* values
+    (``BinnedMatrix.rep_block``): every committed threshold is a cut value of
+    the same cut set, so leaf routing — and therefore the margins — is
+    bit-identical to predicting from the original floats, at O(block) peak
+    memory instead of O(dataset).
+    """
+    if not isinstance(dm, BinnedMatrix):
+        return np.asarray(forest.predict_margin(dm.features), np.float32)
+    if dm.num_row == 0:
+        return np.zeros((0,), np.float32)
+    parts = [
+        np.asarray(
+            forest.predict_margin(dm.rep_block(s, min(s + block_rows, dm.num_row))),
+            np.float32,
+        )
+        for s in range(0, dm.num_row, block_rows)
+    ]
+    return np.concatenate(parts, axis=0)
 
 
 def _merged_distributed_cuts(dtrain, max_bin, weights=None):
@@ -487,6 +512,7 @@ class _TrainingSession:
                 pos[perm[m]] = np.nonzero(m)[0]
                 self.rank_pos = pos
 
+        pre_binned = isinstance(dtrain, BinnedMatrix)
         shared_cuts = None
         if self.is_multiprocess:
             if config.max_bin is None:
@@ -499,23 +525,59 @@ class _TrainingSession:
             # every host must bin with identical thresholds or the psum'd
             # histograms are meaningless: merge the per-host quantile sketches
             # (allgather candidate cuts, union, re-select) — the TPU analog of
-            # xgboost's allreduced weighted quantile sketch
-            shared_cuts = _merged_distributed_cuts(dtrain, config.max_bin)
+            # xgboost's allreduced weighted quantile sketch. Pre-binned input
+            # (chunked streaming ingest) already agreed its cuts cross-rank
+            # through the ingest sketch allgather, so it skips this.
+            if not pre_binned:
+                shared_cuts = _merged_distributed_cuts(dtrain, config.max_bin)
 
-        self.train_binned = bin_matrix(
-            dtrain,
-            config.max_bin,
-            cut_points=shared_cuts,
-            exact_cap=config.exact_bin_cap,
-        )
+        if pre_binned:
+            # chunked streaming ingest: the sketch+bin stage already ran at
+            # ingest time (with rank-agreed cuts); trust the matrix, but
+            # fail loudly on a config/ingest max_bin drift — a silently
+            # re-interpreted bin width would corrupt every histogram
+            if config.max_bin is None or int(config.max_bin) != dtrain.max_bin:
+                raise exc.UserError(
+                    "Pre-binned training data was ingested with max_bin={} "
+                    "but the training config resolves max_bin={}; re-ingest "
+                    "or align the hyperparameters.".format(
+                        dtrain.max_bin, config.max_bin
+                    )
+                )
+            self.train_binned = dtrain
+        else:
+            self.train_binned = bin_matrix(
+                dtrain,
+                config.max_bin,
+                cut_points=shared_cuts,
+                exact_cap=config.exact_bin_cap,
+            )
         self.cuts = self.train_binned.cut_points
         self.eval_sets = []
         for dm, name in evals:
-            binned = (
-                self.train_binned
-                if dm is dtrain
-                else bin_matrix(dm, config.max_bin, cut_points=self.cuts)
-            )
+            if dm is dtrain:
+                binned = self.train_binned
+            elif isinstance(dm, BinnedMatrix):
+                # pre-binned eval set: must carry the training channel's
+                # bin edges (streaming ingest bins validation with the
+                # train cuts) or its bin indices mean different thresholds
+                if dm.max_bin != self.train_binned.max_bin or not (
+                    dm.cut_points is self.cuts
+                    or (
+                        len(dm.cut_points) == len(self.cuts)
+                        and all(
+                            np.array_equal(a, b)
+                            for a, b in zip(dm.cut_points, self.cuts)
+                        )
+                    )
+                ):
+                    raise exc.AlgorithmError(
+                        "pre-binned eval set {!r} was binned with different "
+                        "cut points than the training data".format(name)
+                    )
+                binned = dm
+            else:
+                binned = bin_matrix(dm, config.max_bin, cut_points=self.cuts)
             self.eval_sets.append((name, dm, binned))
 
         def _agreed_pad(num_row):
@@ -591,6 +653,16 @@ class _TrainingSession:
             config.tree_method == "approx"
             and os.environ.get("GRAFT_APPROX_RESKETCH", "1") != "0"
         )
+        if self.approx_resketch and pre_binned:
+            # the per-round re-sketch needs the float channel resident —
+            # exactly what chunked ingest exists to avoid. The ingest gating
+            # refuses approx up front; this is the defense for direct API
+            # callers handing a BinnedMatrix to an approx config.
+            logger.warning(
+                "tree_method='approx' with pre-binned input keeps the "
+                "ingest-time sketch (no per-iteration re-binning)."
+            )
+            self.approx_resketch = False
         if self.approx_resketch and self.rank_perm is not None:
             logger.warning(
                 "tree_method='approx' with distributed ranking keeps the "
@@ -611,7 +683,7 @@ class _TrainingSession:
         base = self.objective.base_margin(forest.base_score)
         shape = (n_pad,) if self.num_group == 1 else (n_pad, self.num_group)
         if forest.trees:
-            margin = forest.predict_margin(dtrain.features).reshape(
+            margin = _predict_margin_rows(forest, dtrain).reshape(
                 (self.n,) if self.num_group == 1 else (self.n, self.num_group)
             )
             self.margins = _put(
@@ -646,7 +718,7 @@ class _TrainingSession:
             )
             eshape = (m_pad,) if self.num_group == 1 else (m_pad, self.num_group)
             if forest.trees:
-                em = forest.predict_margin(dm.features).reshape(
+                em = _predict_margin_rows(forest, dm).reshape(
                     (dm.num_row,) if self.num_group == 1 else (dm.num_row, self.num_group)
                 )
                 self.eval_margins.append(
@@ -1485,7 +1557,7 @@ class _TrainingSession:
             self._global_rows_cache = {}
         if forest is not None:
             def _committed_margin(dm):
-                m = np.asarray(forest.predict_margin(dm.features), np.float32)
+                m = _predict_margin_rows(forest, dm)
                 return m.reshape(
                     (dm.num_row,)
                     if self.num_group == 1
@@ -1707,6 +1779,21 @@ def train(
     """
     config = TrainConfig(params)
     callbacks = list(callbacks or [])
+
+    if isinstance(dtrain, BinnedMatrix) and (
+        config.booster != "gbtree" or config.process_type != "default"
+    ):
+        # gblinear fits raw floats and update/refresh recomputes leaf stats
+        # from them — representative values would silently change the model.
+        # (The streaming-ingest gating refuses these configs up front; this
+        # guards direct API callers.)
+        raise exc.UserError(
+            "Pre-binned training input (chunked ingest) requires "
+            "booster='gbtree' with process_type='default'; got booster={!r} "
+            "process_type={!r}. Use SM_INGEST_MODE=whole.".format(
+                config.booster, config.process_type
+            )
+        )
 
     if config.process_type == "update" and config.booster == "gblinear":
         # checked before the gblinear branch returns: otherwise a refresh

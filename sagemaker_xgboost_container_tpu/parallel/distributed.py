@@ -171,7 +171,8 @@ class Cluster:
             )
         )
 
-    def synchronize(self, payload, timeout=300, recv_timeout=None):
+    def synchronize(self, payload, timeout=300, recv_timeout=None,
+                    max_frame_bytes=MAX_CONTROL_FRAME_BYTES):
         """Allgather small JSON payloads across hosts -> list in rank order.
 
         Master accepts one connection per worker, collects payloads, sends
@@ -185,6 +186,12 @@ class Cluster:
         (``SM_SYNC_RECV_TIMEOUT_S``, default 30s) via the total-deadline
         frame reader. On expiry the master raises ``PlatformError`` naming
         the missing ranks/hosts.
+
+        ``max_frame_bytes`` bounds each received frame; exchanges whose
+        payloads legitimately exceed the 1 MiB control default (the ingest
+        sketch allgather scales with features x wire cap x world size)
+        pass a budget sized to what they actually send — every rank must
+        pass the same value or the reply is refused on the smaller side.
         """
         if self.num_hosts == 1:
             return [payload]
@@ -211,7 +218,8 @@ class Cluster:
                     fault_point("sync.accept", addr=addr)
                     try:
                         msg = recv_message_bounded(
-                            conn, min(recv_timeout, remaining)
+                            conn, min(recv_timeout, remaining),
+                            max_bytes=max_frame_bytes,
                         )
                         rank = int(msg["rank"])
                         if not 0 <= rank < self.num_hosts or rank in results:
@@ -258,7 +266,8 @@ class Cluster:
                 try:
                     _send_msg(sock, {"rank": self.rank, "payload": payload})
                     return recv_message_bounded(
-                        sock, max(deadline - time.monotonic(), 0.1)
+                        sock, max(deadline - time.monotonic(), 0.1),
+                        max_bytes=max_frame_bytes,
                     )
                 finally:
                     sock.close()

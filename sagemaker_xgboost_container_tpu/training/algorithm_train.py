@@ -43,10 +43,62 @@ logger = logging.getLogger(__name__)
 SM_OUTPUT_DATA_DIR = "SM_OUTPUT_DATA_DIR"
 
 
+def _streaming_plan(train_cfg, train_size, combine_train_val, is_pipe, num_hosts):
+    """Decide whole-file vs chunked ingest. -> (use_streaming, max_bin, cfg).
+
+    ``SM_INGEST_MODE=chunked`` forces the chunked path (and raises on an
+    unsupported config rather than silently falling back); ``whole`` pins
+    the legacy readers; ``auto`` streams a supported single-host job whose
+    local channel exceeds one chunk. Multi-host ``auto`` stays on the
+    whole-file path: the decision must be identical on every rank before
+    any rendezvous exists, and local channel sizes (ShardedByS3Key) are
+    not — forcing ``chunked`` via env is uniform by construction.
+    """
+    from ..data import streaming
+
+    cfg = streaming.resolve_ingest_config()
+    if train_cfg is None or is_pipe:
+        # forced chunked must refuse, not silently fall back (the documented
+        # contract — every other unsupported combination raises)
+        if cfg.mode == "chunked":
+            raise exc.UserError(
+                "SM_INGEST_MODE=chunked is not supported for {}; use "
+                "SM_INGEST_MODE=whole.".format(
+                    "Pipe-mode input" if is_pipe
+                    else "jobs without a validated training config"
+                )
+            )
+        return False, None, cfg
+    if cfg.mode == "whole":
+        return False, None, cfg
+    ok, why, max_bin = streaming.supports_streaming(train_cfg)
+    if combine_train_val and ok:
+        ok, why = False, "k-fold CV slices float features per fold"
+    if cfg.mode == "chunked":
+        if not ok:
+            raise exc.UserError(
+                "SM_INGEST_MODE=chunked is not supported for this job ({}); "
+                "use SM_INGEST_MODE=whole or adjust the config.".format(why)
+            )
+        return True, max_bin, cfg
+    # auto
+    if not ok or num_hosts > 1:
+        return False, None, cfg
+    return train_size > cfg.chunk_bytes, max_bin, cfg
+
+
 def get_validated_data_matrices(
-    train_path, validate_path, content_type, csv_weights=0, is_pipe=False, combine_train_val=False
+    train_path, validate_path, content_type, csv_weights=0, is_pipe=False,
+    combine_train_val=False, train_cfg=None, sm_hosts=None, sm_current_host=None,
 ):
-    """Size/format-check both channels and parse them into DataMatrix objects."""
+    """Size/format-check both channels and parse them into DataMatrix objects.
+
+    With the streaming plane armed (``_streaming_plan``) the channels ingest
+    chunk-by-chunk into pre-binned matrices instead (``data/streaming.py``):
+    the training channel builds the (rank-agreed) cuts, the validation
+    channel bins with them. Failures of the chunked plane raise
+    ``streaming.IngestError`` — the caller converts them to exit 85.
+    """
     train_size = get_size(train_path, is_pipe) if train_path else 0
     val_size = get_size(validate_path, is_pipe) if validate_path else 0
 
@@ -55,6 +107,61 @@ def get_validated_data_matrices(
             validate_data_file_path(train_path, content_type)
         if val_size > 0:
             validate_data_file_path(validate_path, content_type)
+
+    num_hosts = len(sm_hosts) if sm_hosts else 1
+    use_streaming, max_bin, _cfg = _streaming_plan(
+        train_cfg, train_size, combine_train_val, is_pipe, num_hosts
+    )
+    if use_streaming:
+        from ..data import streaming
+
+        if streaming.channel_has_sidecars(content_type, train_path, validate_path):
+            if _cfg.mode == "chunked":
+                raise exc.UserError(
+                    "SM_INGEST_MODE=chunked cannot honor libsvm .weight/"
+                    ".group sidecar files; remove them or use "
+                    "SM_INGEST_MODE=whole."
+                )
+            logger.info(
+                "channel carries libsvm .weight/.group sidecar files; "
+                "using the whole-file readers (chunked ingest cannot "
+                "honor them)"
+            )
+            use_streaming = False
+    if use_streaming:
+        hosts = sm_hosts if num_hosts > 1 else None
+        # job-scoped quarantine/budget state: a second ingest in this
+        # process (local mode, an elastic-reform replay) must not inherit
+        # the previous run's consumed skip budget or carry its quarantine
+        # entries into this model's manifest
+        streaming.reset_ingest_state()
+        logger.info(
+            "Streaming (chunked) channel ingest armed: max_bin=%d, %d host(s)",
+            max_bin, num_hosts,
+        )
+        # every host joins the ingest exchange regardless of local channel
+        # size (a data-less host contributes an empty sketch and returns
+        # None) — peers must never hang waiting for its summary
+        train_dmatrix = streaming.ingest_channel(
+            train_path, content_type, max_bin, channel="train",
+            csv_weights=csv_weights, hosts=hosts, current_host=sm_current_host,
+        )
+        val_dmatrix = None
+        if validate_path is not None and (val_size > 0 or num_hosts > 1):
+            val_dmatrix = streaming.ingest_channel(
+                validate_path, content_type, max_bin, channel="validation",
+                csv_weights=csv_weights,
+                cut_points=train_dmatrix.cut_points if train_dmatrix else None,
+                hosts=hosts, current_host=sm_current_host,
+            )
+            if train_dmatrix is None:
+                # a train-data-less rank still joined the validation
+                # exchange (peers must never hang waiting for it), but
+                # without the agreed train cuts its local validation matrix
+                # was re-sketched against itself — it must not leak into
+                # eval; the rank exits via the existing no-data contract
+                val_dmatrix = None
+        return train_dmatrix, val_dmatrix, train_dmatrix
 
     train_dmatrix = (
         get_data_matrix(train_path, content_type, csv_weights=csv_weights, is_pipe=is_pipe)
@@ -125,9 +232,22 @@ def sagemaker_train(
         )
 
     with span("data_ingest", emit=True):
-        train_dmatrix, val_dmatrix, train_val_dmatrix = get_validated_data_matrices(
-            train_path, val_path, file_type, csv_weights, is_pipe, combine_train_val
-        )
+        from ..data import streaming
+
+        try:
+            train_dmatrix, val_dmatrix, train_val_dmatrix = get_validated_data_matrices(
+                train_path, val_path, file_type, csv_weights, is_pipe,
+                combine_train_val, train_cfg=validated_train_config,
+                sm_hosts=sm_hosts, sm_current_host=sm_current_host,
+            )
+        except streaming.IngestError as e:
+            # the chunked plane's failure contract: every rank reached this
+            # same verdict from the same allgathered state — flight-recorder
+            # dump + EXIT_INGEST_FAILED (85) on all of them
+            streaming.abort_on_ingest_failure(e)
+            # only reachable when the exit is patched (tests): classify as
+            # a platform failure so the failure file names the ingest error
+            raise exc.PlatformError(str(e))
     missing_validation_data = validation_channel and not val_dmatrix
 
     train_args = dict(
@@ -613,6 +733,7 @@ def train_job(
 
     os.makedirs(model_dir, exist_ok=True)
     if is_master:
+        from ..data import streaming
         from ..utils import integrity
         from . import elastic
 
@@ -624,17 +745,29 @@ def train_job(
                 # failed sidecar write must not fail a finished job (the
                 # model loads manifest-less, exactly like older runs).
                 # A model that trained through elastic shrinks carries the
-                # full membership log — the provenance record for "this
-                # artifact lost host N's rows at epoch E".
+                # full membership log, and one that trained past quarantined
+                # input chunks carries the agreed quarantine record — the
+                # provenance for "this artifact lost those rows".
                 integrity.write_manifest(
                     model_location,
                     fingerprint=integrity.config_fingerprint(train_cfg),
                     membership_log=elastic.membership_log() or None,
+                    quarantine=streaming.quarantine_record(),
                 )
             except OSError as e:
                 logger.warning(
                     "could not write model manifest for %s: %s", model_location, e
                 )
+
+        try:
+            # the standalone quarantine manifest (ingest-quarantine.json)
+            # rides next to the model so operators can audit skipped input
+            # without parsing the model sidecar; absent when nothing skipped
+            qpath = streaming.write_quarantine_manifest(model_dir)
+            if qpath:
+                logger.warning("ingest quarantine manifest written to %s", qpath)
+        except OSError as e:
+            logger.warning("could not write ingest quarantine manifest: %s", e)
 
         with span("model_save", emit=True):
             if not isinstance(bst, list):

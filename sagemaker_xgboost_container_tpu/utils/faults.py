@@ -26,6 +26,10 @@ Spec grammar (entries separated by ``;`` or ``,``)::
     predict.dispatch:sleep:5      request-thread predict stalls (deadline
                                   drills); serving.encode is its twin on
                                   the response side
+    data.chunk:error:rot@2        the 2nd streaming-ingest chunk read fails
+                                  (retry->skip->quarantine drills; @2+ with
+                                  a small SM_INGEST_MAX_BAD_CHUNKS drills
+                                  budget exhaustion -> exit 85)
 
 Actions: ``error[:msg]`` -> OSError, ``drop`` -> ConnectionError,
 ``sleep:<seconds>``, ``sigterm`` (os.kill SIGTERM), ``exit:<code>``

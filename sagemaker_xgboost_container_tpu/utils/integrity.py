@@ -152,6 +152,7 @@ def build_manifest(
     digest=None,
     size=None,
     membership_log=None,
+    quarantine=None,
 ):
     """Manifest dict for a model file — THE schema definition; every writer
     (checkpoint sidecars, final-model sidecars) goes through here. ``digest``
@@ -159,7 +160,10 @@ def build_manifest(
     file before renaming it into place. ``membership_log`` (elastic
     shrink-to-continue) is the append-only list of recorded world-size
     transitions the model trained through — the artifact later resumes
-    validate ``world_size`` drift against."""
+    validate ``world_size`` drift against. ``quarantine`` (streaming
+    ingest) records the cross-rank-agreed set of input chunks the job
+    trained *without* — the provenance record for 'this artifact lost
+    those rows to corrupt input' (data/streaming.quarantine_record)."""
     manifest = {
         "manifest_version": MANIFEST_VERSION,
         "sha256": digest if digest is not None else file_digest(model_path),
@@ -171,6 +175,8 @@ def build_manifest(
         manifest["fingerprint"] = dict(fingerprint)
     if membership_log:
         manifest["membership_log"] = [dict(t) for t in membership_log]
+    if quarantine:
+        manifest["quarantine"] = dict(quarantine)
     return manifest
 
 
@@ -192,7 +198,8 @@ def dump_manifest_atomic(target_path, manifest, tmp_path):
         raise
 
 
-def write_manifest(model_path, iteration=None, fingerprint=None, membership_log=None):
+def write_manifest(model_path, iteration=None, fingerprint=None, membership_log=None,
+                   quarantine=None):
     """Write ``model_path``'s sidecar manifest (tmp + rename, best-effort
     atomic). Used for final model artifacts in ``model_dir`` — serving's
     ``check_model_file`` digest-verifies any artifact whose manifest
@@ -203,6 +210,7 @@ def write_manifest(model_path, iteration=None, fingerprint=None, membership_log=
         iteration=iteration,
         fingerprint=fingerprint,
         membership_log=membership_log,
+        quarantine=quarantine,
     )
     target = manifest_path(model_path)
     # dot-prefixed temp: the serving loader skips dotfiles, so a crash here

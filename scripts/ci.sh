@@ -85,6 +85,18 @@ case "$TIER" in
         echo "CI $TIER TIER FAILED (serve drill; see $ARTIFACT_DIR/serve)"
       fi
     fi
+    if [ $rc -eq 0 ]; then
+      # resilient-ingest drills: corrupt chunks under the 2-rank skip
+      # consensus (quarantined model), fail policy and budget exhaustion
+      # (exit 85), archiving quarantine manifests + flight recorders
+      if PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$REPO/scripts/ingest_drill.py" "$ARTIFACT_DIR/ingest"; then
+        echo "ingest drill: OK (artifacts: $ARTIFACT_DIR/ingest)"
+      else
+        rc=1
+        echo "CI $TIER TIER FAILED (ingest drill; see $ARTIFACT_DIR/ingest)"
+      fi
+    fi
     # the case arm's status feeds the shared rc=$? below
     (exit $rc)
     ;;

@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Resilient-ingest chaos drill: corrupt chunks under a 2-rank skip consensus.
+
+Self-spawning harness (parent mode spawns rank children of this same file)
+exercising the chunked streaming-ingest plane (``data/streaming.py``) end to
+end on loopback. Two ranks share one replicated CSV channel with
+``SM_INGEST_SHARD=1`` (round-robin chunk assignment) and ``data.chunk``
+faults are armed on rank 1's env:
+
+* ``--mode skip`` — ``SM_INGEST_BAD_CHUNK_ACTION=skip``: rank 1's faulted
+  chunk fails past its retries, the skip set is agreed cross-rank, both
+  ranks finish ingest + a short training run, and the parent asserts: both
+  ranks exit 0, BOTH ranks recorded the **identical** quarantine (the
+  rank-consistency drill), the final model's manifest carries the
+  quarantine record, ``ingest-quarantine.json`` names the bad chunk, and
+  the model passes serving's verified load.
+* ``--mode fail`` — the default ``fail`` policy with the same fault: every
+  rank must exit 85 (``EXIT_INGEST_FAILED``) with a ``training.abort``
+  record naming ``ingest_failed`` and a flight-recorder dump.
+* ``--mode budget`` — ``skip`` policy but ``SM_INGEST_MAX_BAD_CHUNKS=1``
+  with a persistent fault (``@2+``): the agreed bad-chunk count exceeds the
+  budget and every rank exits 85 with dumps.
+
+Artifacts (quarantine manifests, model manifest, flight-recorder dumps,
+per-rank stdout) are archived under the given directory — CI wires this
+into the chaos tier with ``${CI_ARTIFACT_DIR:-.ci-artifacts}/ingest/``.
+
+Exit code: 0 when every assertion holds, 1 otherwise (2 on usage errors).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_RANKS = 2
+NUM_ROUND = 4
+N_FILES = 4
+ROWS_PER_FILE = 700
+CHUNK_BYTES = 8192
+
+
+def _free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _write_channel(data_dir):
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.RandomState(7)
+    for i in range(N_FILES):
+        arr = np.column_stack(
+            [rng.randint(0, 2, ROWS_PER_FILE), rng.rand(ROWS_PER_FILE, 6).round(4)]
+        )
+        np.savetxt(
+            os.path.join(data_dir, "part-{:03d}.csv".format(i)),
+            arr,
+            delimiter=",",
+            fmt="%.6g",
+        )
+
+
+# --------------------------------------------------------------- rank child
+def rank_main(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from sagemaker_xgboost_container_tpu.data import streaming
+    from sagemaker_xgboost_container_tpu.models import booster
+    from sagemaker_xgboost_container_tpu.telemetry import tracing
+    from sagemaker_xgboost_container_tpu.utils import integrity
+    from sagemaker_xgboost_container_tpu.utils.logging_config import (
+        setup_main_logger,
+    )
+
+    setup_main_logger("ingest_drill")
+    rank = args.rank
+    hosts = ["algo-{}".format(i + 1) for i in range(args.n_ranks)]
+    current = hosts[rank]
+    tracing.set_rank(rank)
+    model_dir = os.path.join(args.workdir, "model")
+
+    try:
+        binned = streaming.ingest_channel(
+            args.data_dir,
+            "text/csv",
+            256,
+            channel="train",
+            hosts=hosts,
+            current_host=current,
+            master_addr="127.0.0.1",
+        )
+    except streaming.IngestError as e:
+        # the production wiring (algorithm_train.sagemaker_train) does
+        # exactly this: coordinated flight-recorder dump + exit 85
+        streaming.abort_on_ingest_failure(e)
+        return 1  # unreachable: abort_on_ingest_failure hard-exits
+
+    record = streaming.quarantine_record()
+    print(
+        json.dumps(
+            {
+                "metric": "drill.quarantine",
+                "rank": rank,
+                "record": record,
+                "rows": binned.num_row,
+            },
+            sort_keys=True,
+        ),
+        flush=True,
+    )
+
+    params = {"objective": "binary:logistic", "max_depth": 2, "seed": 3}
+    forest = booster.train(dict(params), binned, num_boost_round=NUM_ROUND)
+
+    if rank == 0:
+        os.makedirs(model_dir, exist_ok=True)
+        model_location = os.path.join(model_dir, "xgboost-model")
+        forest.save_model(model_location)
+        integrity.write_manifest(
+            model_location,
+            fingerprint=integrity.config_fingerprint(params),
+            quarantine=record,
+        )
+        streaming.write_quarantine_manifest(model_dir)
+    print(
+        json.dumps(
+            {
+                "metric": "drill.done",
+                "rank": rank,
+                "rounds": forest.num_boosted_rounds,
+                "rows": binned.num_row,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+# ------------------------------------------------------------------- parent
+def _spawn(mode, workdir, data_dir):
+    ingest_port = _free_port()
+    procs = []
+    for rank in range(N_RANKS):
+        env = dict(os.environ)
+        for stale in (
+            "SM_FAULT_SPEC",
+            "SM_INGEST_MODE",
+            "SM_INGEST_BAD_CHUNK_ACTION",
+            "SM_INGEST_MAX_BAD_CHUNKS",
+            "SM_TRACE",
+        ):
+            env.pop(stale, None)
+        trace_dir = os.path.join(workdir, "trace-rank{}".format(rank))
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO,
+                "SM_INGEST_MODE": "chunked",
+                "SM_INGEST_SHARD": "1",
+                "SM_INGEST_CHUNK_BYTES": str(CHUNK_BYTES),
+                "SM_INGEST_PORT": str(ingest_port),
+                "SM_INGEST_TIMEOUT_S": "60",
+                "SM_IO_RETRY_ATTEMPTS": "1",
+                "SM_IO_RETRY_BACKOFF_S": "0.01",
+                "SM_TRACE": "1",
+                "SM_TRACE_EXPORT_DIR": trace_dir,
+            }
+        )
+        if mode == "skip":
+            env["SM_INGEST_BAD_CHUNK_ACTION"] = "skip"
+        elif mode == "budget":
+            env["SM_INGEST_BAD_CHUNK_ACTION"] = "skip"
+            env["SM_INGEST_MAX_BAD_CHUNKS"] = "1"
+        if rank == 1:
+            if mode == "budget":
+                # persistent corruption: every chunk from the 2nd hit on
+                env["SM_FAULT_SPEC"] = "data.chunk:error:injected corruption@2+"
+            else:
+                env["SM_FAULT_SPEC"] = "data.chunk:error:injected corruption@2"
+        out = open(os.path.join(workdir, "rank{}.out".format(rank)), "w")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        os.path.abspath(__file__),
+                        "--rank", str(rank),
+                        "--n-ranks", str(N_RANKS),
+                        "--workdir", workdir,
+                        "--data-dir", data_dir,
+                    ],
+                    env=env,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                ),
+                out,
+            )
+        )
+    codes = []
+    for proc, out in procs:
+        try:
+            proc.wait(timeout=180)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        out.close()
+        codes.append(proc.returncode)
+    return codes
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def _records(text, metric):
+    prefix = '{{"metric": "{}"'.format(metric)
+    return [json.loads(l) for l in text.splitlines() if l.startswith(prefix)]
+
+
+def _check(ok, message, failures):
+    print(("ok: " if ok else "FAIL: ") + message, flush=True)
+    if not ok:
+        failures.append(message)
+    return ok
+
+
+def _verify_skip(workdir, codes, failures):
+    records = []
+    for rank in range(N_RANKS):
+        out = _read(os.path.join(workdir, "rank{}.out".format(rank)))
+        _check(
+            codes[rank] == 0,
+            "rank {} completed ingest+train (rc={})".format(rank, codes[rank]),
+            failures,
+        )
+        q = _records(out, "drill.quarantine")
+        _check(bool(q), "rank {} emitted its quarantine record".format(rank), failures)
+        records.append(q[0]["record"] if q else None)
+        done = _records(out, "drill.done")
+        _check(
+            bool(done) and done[0]["rounds"] == NUM_ROUND,
+            "rank {} trained all {} rounds on the surviving rows".format(
+                rank, NUM_ROUND
+            ),
+            failures,
+        )
+    # THE rank-consistency assertion: both ranks agreed on the same skip set
+    _check(
+        records[0] is not None and records[0] == records[1],
+        "both ranks hold the identical agreed quarantine record",
+        failures,
+    )
+    _check(
+        bool(records[0]) and records[0]["chunks_skipped"] >= 1
+        and all(c["rank"] == 1 for c in records[0]["skipped_chunks"]),
+        "quarantine names rank 1's corrupt chunk(s)",
+        failures,
+    )
+
+    qpath = os.path.join(workdir, "model", "ingest-quarantine.json")
+    _check(os.path.exists(qpath), "ingest-quarantine.json written", failures)
+    model_path = os.path.join(workdir, "model", "xgboost-model")
+    manifest_path = model_path + ".manifest"
+    if os.path.exists(manifest_path):
+        manifest = json.loads(_read(manifest_path))
+        _check(
+            manifest.get("quarantine", {}).get("chunks_skipped", 0) >= 1,
+            "final model manifest carries the quarantine record",
+            failures,
+        )
+    else:
+        _check(False, "final model manifest exists", failures)
+    try:
+        from sagemaker_xgboost_container_tpu.serving import serve_utils
+
+        serve_utils._load_verified(model_path)
+        _check(True, "final model passes serving's verified load", failures)
+    except Exception as e:
+        _check(
+            False,
+            "final model passes serving's verified load ({})".format(e),
+            failures,
+        )
+
+
+def _verify_exit85(workdir, codes, failures, mode):
+    want_reason = "ingest_failed"
+    for rank in range(N_RANKS):
+        out = _read(os.path.join(workdir, "rank{}.out".format(rank)))
+        _check(
+            codes[rank] == 85,
+            "{}: rank {} exits EXIT_INGEST_FAILED (rc={}, want 85)".format(
+                mode, rank, codes[rank]
+            ),
+            failures,
+        )
+        aborts = _records(out, "training.abort")
+        _check(
+            bool(aborts)
+            and aborts[0]["reason"] == want_reason
+            and aborts[0]["exit_code"] == 85,
+            "{}: rank {} training.abort names {}/85".format(mode, rank, want_reason),
+            failures,
+        )
+        dump = aborts[0].get("flight_recorder") if aborts else None
+        _check(
+            bool(dump) and os.path.exists(dump),
+            "{}: rank {} left a flight-recorder dump ({})".format(mode, rank, dump),
+            failures,
+        )
+
+
+def _archive(workdir, artifact_dir, mode):
+    dest = os.path.join(artifact_dir, mode)
+    os.makedirs(dest, exist_ok=True)
+    for name in sorted(os.listdir(workdir)):
+        src = os.path.join(workdir, name)
+        if name.endswith(".out"):
+            shutil.copy2(src, dest)
+        elif name.startswith("trace-rank") and os.path.isdir(src):
+            for f in os.listdir(src):
+                shutil.copy2(os.path.join(src, f), os.path.join(dest, f))
+    for extra in ("model/ingest-quarantine.json", "model/xgboost-model.manifest"):
+        p = os.path.join(workdir, extra)
+        if os.path.exists(p):
+            shutil.copy2(p, dest)
+    print("artifacts archived under {}".format(dest), flush=True)
+
+
+def parent_main(args):
+    failures = []
+    modes = [args.mode] if args.mode != "all" else ["skip", "fail", "budget"]
+    artifact_dir = os.path.abspath(args.artifact_dir)
+    os.makedirs(artifact_dir, exist_ok=True)
+    for mode in modes:
+        print("--- ingest drill: {} ---".format(mode), flush=True)
+        workdir = tempfile.mkdtemp(prefix="ingest-{}-".format(mode))
+        data_dir = os.path.join(workdir, "channel")
+        try:
+            _write_channel(data_dir)
+            codes = _spawn(mode, workdir, data_dir)
+            print("rank exit codes: {}".format(codes), flush=True)
+            if mode == "skip":
+                _verify_skip(workdir, codes, failures)
+            else:
+                _verify_exit85(workdir, codes, failures, mode)
+            _archive(workdir, artifact_dir, mode)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        print("INGEST DRILL FAILED ({} assertion(s))".format(len(failures)), flush=True)
+        return 1
+    print("INGEST DRILL OK", flush=True)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("artifact_dir", nargs="?", default=".ci-artifacts/ingest")
+    parser.add_argument(
+        "--mode", choices=["skip", "fail", "budget", "all"], default="all"
+    )
+    parser.add_argument("--rank", type=int, default=None)
+    parser.add_argument("--n-ranks", type=int, default=N_RANKS)
+    parser.add_argument("--workdir")
+    parser.add_argument("--data-dir")
+    args = parser.parse_args(argv)
+    if args.rank is not None:
+        return rank_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

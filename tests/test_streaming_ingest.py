@@ -1,0 +1,1208 @@
+"""Resilient out-of-core ingest: chunked sharded readers + skip/quarantine.
+
+The contract under test (data/streaming.py):
+
+* **Bit-identity** — on fault-free input the chunked path produces the same
+  binned matrix, the same cuts, and bitwise-identical committed trees
+  (packed-tree fields + prediction u32 views) as the whole-file readers,
+  across formats and chunk sizes.
+* **Bounded memory** — ingesting a channel many times larger than one chunk
+  costs O(chunk + sketch + binned shard) incremental RSS, not O(float32
+  dataset) (subprocess high-water-mark comparison).
+* **Corrupt-input matrix** — truncated / garbage / mixed-width files per
+  format through the whole-file path (UserError) and the chunked path under
+  both the ``fail`` (IngestError -> exit 85) and ``skip`` (cross-rank
+  quarantine) policies.
+* **Rank consistency** — two loopback ranks sharding one channel agree on
+  the identical skip set and derive identical cuts (the subprocess twin
+  lives in scripts/ingest_drill.py, wired into the chaos tier).
+
+Plus the satellite fixes: empty-file skip (all four formats), cross-file
+CSV delimiter validation, deterministic leaf-dir/file ordering.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.data import binning, readers, streaming
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.data.recordio import write_recordio_protobuf
+from sagemaker_xgboost_container_tpu.models import train
+from sagemaker_xgboost_container_tpu.telemetry.registry import REGISTRY
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+from sagemaker_xgboost_container_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TREE_FIELDS = (
+    "feature",
+    "threshold",
+    "default_left",
+    "left",
+    "right",
+    "value",
+    "base_weight",
+    "gain",
+    "sum_hess",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    streaming.reset_ingest_state()
+    faults.reset()
+    yield
+    streaming.reset_ingest_state()
+    faults.reset()
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------- channels
+
+
+def _rows(n, d, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 2, n).astype(np.float32)
+    X = rng.rand(n, d).astype(np.float32).round(4)
+    X[rng.rand(n, d) < 0.08] = np.nan
+    return labels, X
+
+
+def _csv_channel(path, n_files=3, rows=250, d=6, seed=0):
+    os.makedirs(path, exist_ok=True)
+    total = 0
+    for i in range(n_files):
+        labels, X = _rows(rows, d, seed + i)
+        arr = np.column_stack([labels, np.nan_to_num(X, nan=0.0)])
+        np.savetxt(
+            os.path.join(path, "part-{:02d}.csv".format(i)),
+            arr, delimiter=",", fmt="%.6g",
+        )
+        total += rows
+    return total
+
+
+def _libsvm_channel(path, n_files=3, rows=200, d=6, seed=0):
+    os.makedirs(path, exist_ok=True)
+    for i in range(n_files):
+        labels, X = _rows(rows, d, seed + i)
+        lines = []
+        for r in range(rows):
+            toks = ["%g" % labels[r]]
+            for f in range(d):
+                if not np.isnan(X[r, f]):
+                    toks.append("{}:{:.4f}".format(f, X[r, f]))
+            lines.append(" ".join(toks))
+        with open(os.path.join(path, "part-{:02d}.libsvm".format(i)), "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+    return n_files * rows
+
+
+def _parquet_channel(path, n_files=2, rows=300, d=5, seed=0):
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    for i in range(n_files):
+        labels, X = _rows(rows, d, seed + i)
+        frame = pd.DataFrame(
+            np.column_stack([labels, np.nan_to_num(X, nan=0.0)]).astype(np.float32)
+        )
+        frame.columns = [str(c) for c in frame.columns]
+        # several small row groups so chunking has something to split
+        pq.write_table(
+            pa.Table.from_pandas(frame, preserve_index=False),
+            os.path.join(path, "part-{:02d}.parquet".format(i)),
+            row_group_size=64,
+        )
+    return n_files * rows
+
+
+def _recordio_channel(path, n_files=2, rows=300, d=5, seed=0):
+    os.makedirs(path, exist_ok=True)
+    for i in range(n_files):
+        labels, X = _rows(rows, d, seed + i)
+        buf = write_recordio_protobuf(np.nan_to_num(X, nan=0.0), labels=labels)
+        with open(os.path.join(path, "part-{:02d}.pbr".format(i)), "wb") as fh:
+            fh.write(buf)
+    return n_files * rows
+
+
+_CHANNELS = {
+    "csv": ("text/csv", _csv_channel),
+    "libsvm": ("text/libsvm", _libsvm_channel),
+    "parquet": ("application/x-parquet", _parquet_channel),
+    "recordio-protobuf": ("application/x-recordio-protobuf", _recordio_channel),
+}
+
+
+def _ingest(path, content_type, max_bin=256, chunk_bytes=4096, **kw):
+    cfg = streaming.resolve_ingest_config()
+    cfg.chunk_bytes = chunk_bytes
+    for k, v in kw.pop("cfg_overrides", {}).items():
+        setattr(cfg, k, v)
+    return streaming.ingest_channel(
+        path, content_type, max_bin, config=cfg, **kw
+    )
+
+
+# ------------------------------------------------------------- bit identity
+
+
+@pytest.mark.parametrize("fmt", ["csv", "libsvm"])
+@pytest.mark.parametrize("chunk_bytes", [4096, 32768])
+def test_binned_matrix_bit_identity(tmp_path, fmt, chunk_bytes):
+    """Chunked path == whole-file path: bins, labels and cuts, for two text
+    formats at two chunk sizes (the acceptance matrix)."""
+    content_type, make = _CHANNELS[fmt]
+    channel = str(tmp_path / fmt)
+    make(channel)
+    whole = binning.bin_matrix(readers.get_data_matrix(channel, content_type), 256)
+    chunked = _ingest(channel, content_type, chunk_bytes=chunk_bytes)
+    assert chunked.bins.dtype == whole.bins.dtype
+    assert np.array_equal(chunked.bins, whole.bins)
+    assert np.array_equal(chunked.labels, whole.labels)
+    assert len(chunked.cut_points) == len(whole.cut_points)
+    for a, b in zip(chunked.cut_points, whole.cut_points):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.parametrize("fmt", ["parquet", "recordio-protobuf"])
+def test_binned_matrix_bit_identity_binary_formats(tmp_path, fmt):
+    """Row-group (parquet) and record-aligned (recordio) chunking match the
+    whole-file readers bitwise too."""
+    content_type, make = _CHANNELS[fmt]
+    channel = str(tmp_path / "chan")
+    make(channel)
+    whole = binning.bin_matrix(readers.get_data_matrix(channel, content_type), 256)
+    chunked = _ingest(channel, content_type, chunk_bytes=4096)
+    assert np.array_equal(chunked.bins, whole.bins)
+    assert np.array_equal(chunked.labels, whole.labels)
+    for a, b in zip(chunked.cut_points, whole.cut_points):
+        assert np.array_equal(a, b)
+
+
+def test_committed_trees_bit_identity(tmp_path):
+    """Training on the chunked ingest commits bitwise-identical trees and
+    u32-identical predictions vs the whole-file DataMatrix, for two formats
+    x two chunk sizes."""
+    params = {"objective": "binary:logistic", "max_depth": 3, "seed": 11}
+    for fmt in ("csv", "libsvm"):
+        content_type, make = _CHANNELS[fmt]
+        channel = str(tmp_path / ("t-" + fmt))
+        make(channel)
+        dm = readers.get_data_matrix(channel, content_type)
+        reference = train(
+            dict(params), dm, num_boost_round=4, evals=[(dm, "train")]
+        )
+        ref_pred = np.asarray(reference.predict(dm.features), np.float32)
+        for chunk_bytes in (4096, 32768):
+            bm = _ingest(channel, content_type, chunk_bytes=chunk_bytes)
+            forest = train(
+                dict(params), bm, num_boost_round=4, evals=[(bm, "train")]
+            )
+            assert len(forest.trees) == len(reference.trees) and forest.trees
+            for t1, t2 in zip(reference.trees, forest.trees):
+                for k in _TREE_FIELDS:
+                    assert np.array_equal(getattr(t1, k), getattr(t2, k)), (
+                        fmt, chunk_bytes, k,
+                    )
+            pred = np.asarray(forest.predict(dm.features), np.float32)
+            assert np.array_equal(
+                ref_pred.view(np.uint32), pred.view(np.uint32)
+            ), (fmt, chunk_bytes)
+
+
+def test_warm_start_from_binned_bit_identity(tmp_path):
+    """Checkpoint-continuation parity: resuming on pre-binned input predicts
+    warm-start margins from rep_block representatives — committed trees stay
+    u32-identical to the float-feature resume."""
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    dm = readers.get_data_matrix(channel, "text/csv")
+    bm = _ingest(channel, "text/csv")
+    params = {"objective": "binary:logistic", "max_depth": 3, "seed": 5}
+    a = train(dict(params), dm, num_boost_round=2)
+    a2 = train(dict(params), dm, num_boost_round=2, xgb_model=a)
+    b = train(dict(params), bm, num_boost_round=2)
+    b2 = train(dict(params), bm, num_boost_round=2, xgb_model=b)
+    pa_ = np.asarray(a2.predict(dm.features), np.float32)
+    pb = np.asarray(b2.predict(dm.features), np.float32)
+    assert np.array_equal(pa_.view(np.uint32), pb.view(np.uint32))
+
+
+def test_rep_block_routes_identically(tmp_path):
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel, n_files=1, rows=200)
+    dm = readers.get_data_matrix(channel, "text/csv")
+    bm = _ingest(channel, "text/csv")
+    reps = bm.rep_block(0, bm.num_row)
+    rebinned = binning.apply_cut_points(reps, bm.cut_points, bm.max_bin)
+    assert np.array_equal(rebinned, bm.bins)
+    with pytest.raises(exc.AlgorithmError):
+        bm.features  # loud guard: no silent float rehydration
+
+
+# ---------------------------------------------------------- bounded memory
+
+_MEM_CHILD = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["GRAFT_SKETCH_IMPL"] = "host"  # keep ingest off the device path
+    sys.path.insert(0, {repo!r})
+    mode, channel = sys.argv[1], sys.argv[2]
+    from sagemaker_xgboost_container_tpu.data import binning, readers, streaming
+    import pandas, pyarrow.parquet  # pre-warm: lazy imports must not be traced
+
+    # tracemalloc: numpy registers its data buffers with it, so the traced
+    # peak covers the arrays that dominate both paths (pandas blocks, concat
+    # copies, the float matrix, per-chunk blocks, the binned matrix) while
+    # staying independent of the interpreter+jax import RSS — the kernel
+    # high-water mark (ru_maxrss/VmHWM) is swamped by that import peak and
+    # /proc/self/clear_refs is not writable in sandboxed CI
+    import tracemalloc
+
+    tracemalloc.start()
+    tracemalloc.reset_peak()
+    if mode == "whole":
+        dm = readers.get_data_matrix(channel, "text/csv")
+        binned = binning.bin_matrix(dm, 256)
+    else:
+        cfg = streaming.resolve_ingest_config()
+        cfg.chunk_bytes = 4 * 1024 * 1024
+        binned = streaming.ingest_channel(channel, "text/csv", 256, config=cfg)
+    _current, peak = tracemalloc.get_traced_memory()
+    print(json.dumps({{"before_kb": 0, "after_kb": peak // 1024,
+                       "rows": binned.num_row, "cols": binned.num_col}}))
+    """
+)
+
+
+def _run_mem_child(mode, channel):
+    out = subprocess.run(
+        [sys.executable, "-c", _MEM_CHILD.format(repo=REPO), mode, channel],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_bounded_memory_proof(tmp_path):
+    """Ingesting a channel >> chunk size: the chunked path's incremental RSS
+    high-water mark is O(chunk + sketch + binned shard); the whole-file path
+    pays O(float32 dataset) and more. Subprocess children so each path's
+    high-water mark is its own."""
+    channel = tmp_path / "big"
+    channel.mkdir()
+    d = 16
+    block_rows = 20000
+    rng = np.random.RandomState(0)
+    block = np.column_stack(
+        [rng.randint(0, 2, block_rows), rng.rand(block_rows, d).round(4)]
+    ).astype(np.float32)
+    import io
+
+    buf = io.BytesIO()
+    np.savetxt(buf, block, delimiter=",", fmt="%.6g")
+    payload = buf.getvalue()
+    repeats = 30  # 600k rows x 16 cols = ~38 MiB float32, ~2.5 MiB binned
+    with open(channel / "train.csv", "wb") as fh:
+        for _ in range(repeats):
+            fh.write(payload)
+    n_rows = block_rows * repeats
+    float_kb = n_rows * d * 4 // 1024
+
+    whole = _run_mem_child("whole", str(channel))
+    chunked = _run_mem_child("chunked", str(channel))
+    assert whole["rows"] == chunked["rows"] == n_rows
+    whole_peak = whole["after_kb"]
+    chunked_peak = chunked["after_kb"]
+    # numpy registers each data buffer with tracemalloc at ~2x (observed and
+    # stable), identically for both children — the ratio is exact and the
+    # absolute bounds below carry that factor.
+    # sanity: the proxy sees the whole-file float materialization (measured
+    # ~3.7x float here: per-file frames + concat + to_numpy copies)
+    assert whole_peak > 2.0 * float_kb, (whole_peak, float_kb)
+    # the proof: chunked peak is O(chunk + sketch + binned shard) — measured
+    # ~0.25x of the whole-file path and ~0.9x the float dataset (the binned
+    # matrix itself is float/4; the separation grows with dataset size)
+    assert chunked_peak < 0.4 * whole_peak, (chunked_peak, whole_peak)
+    assert chunked_peak < 1.2 * float_kb, (chunked_peak, float_kb)
+
+
+# ------------------------------------------------- satellite reader fixes
+
+
+def test_empty_files_skipped_all_formats(tmp_path):
+    counter = REGISTRY.counter(
+        "ingest_files_empty_total", "Zero-byte channel files skipped during ingest"
+    )
+    start = counter.value
+    for fmt, (content_type, make) in _CHANNELS.items():
+        channel = str(tmp_path / ("empty-" + fmt))
+        expected = make(channel, n_files=2)
+        open(os.path.join(channel, "aaa-empty-part"), "w").close()
+        dm = readers.get_data_matrix(channel, content_type)
+        assert dm.num_row == expected, fmt
+    assert counter.value >= start + 4
+    # validation must skip them too (an empty first file used to kill the
+    # delimiter sniff before any reader ran)
+    channel = str(tmp_path / "empty-validate")
+    _csv_channel(channel, n_files=1)
+    open(os.path.join(channel, "aaa-empty"), "w").close()
+    readers.validate_data_file_path(channel, "text/csv")
+
+
+def test_csv_delimiter_mismatch_names_offending_file(tmp_path):
+    channel = tmp_path / "mixed-delim"
+    channel.mkdir()
+    (channel / "part-00.csv").write_text("1.0,2.0,3.0\n0.0,1.0,2.0\n")
+    (channel / "part-01.csv").write_text("1.0;2.0;3.0\n0.0;1.0;2.0\n")
+    with pytest.raises(exc.UserError) as err:
+        readers.get_data_matrix(str(channel), "text/csv")
+    assert "part-01.csv" in str(err.value)
+    assert "delimiter" in str(err.value).lower()
+    # the chunked planner goes through the same validation
+    with pytest.raises(exc.UserError):
+        _ingest(str(channel), "text/csv")
+
+
+def test_validate_data_file_path_deterministic_leaf(tmp_path):
+    """The leaf-dir fallback used to take os.walk's first (fs-ordered) hit;
+    it must now deterministically pick the sorted-first leaf."""
+    root = tmp_path / "nested"
+    (root / "zz").mkdir(parents=True)
+    (root / "aa").mkdir()
+    (root / "aa" / "bad.libsvm").write_text("not libsvm :: at :: all\n")
+    (root / "zz" / "good.libsvm").write_text("1 0:0.5 1:0.25\n0 0:0.1 1:0.5\n")
+    with pytest.raises(exc.UserError):
+        # sorted-first leaf (aa) must be the one validated — pre-fix, the
+        # first os.walk hit was filesystem-order-dependent
+        readers.validate_data_file_path(str(root), "text/libsvm")
+
+
+def test_list_data_files_order_is_target_stable(tmp_path):
+    channel = tmp_path / "order"
+    channel.mkdir()
+    for name in ("b.csv", "a.csv", "c.csv"):
+        (channel / name).write_text("1.0,2.0\n")
+    staged = readers.stage_input_files(str(channel), staging_dir=str(tmp_path / "st"))
+    files = readers._list_data_files(staged)
+    targets = [os.path.basename(os.path.realpath(f)) for f in files]
+    assert targets == ["a.csv", "b.csv", "c.csv"]
+
+
+# ------------------------------------------------------ corrupt-input matrix
+
+
+def _corrupt_channel(tmp_path, fmt):
+    """A channel with good parts plus one corrupt file (sorted last)."""
+    content_type, make = _CHANNELS[fmt]
+    channel = str(tmp_path / ("corrupt-" + fmt))
+    good_rows = make(channel, n_files=2)
+    bad = os.path.join(channel, "zz-corrupt")
+    if fmt == "csv":
+        with open(bad + ".csv", "w") as fh:
+            fh.write("1.0,junk,2.0\n0.0\n1.0,2.0,3.0,4.0,5.0,6.0,7.0,8.0,9.0\n")
+    elif fmt == "libsvm":
+        with open(bad + ".libsvm", "w") as fh:
+            fh.write("1 0:0.5 not:a:valid:token 3:0.2\n")
+    elif fmt == "parquet":
+        with open(bad + ".parquet", "wb") as fh:
+            fh.write(b"\x89PNG not parquet at all" * 40)
+    else:
+        with open(bad + ".pbr", "wb") as fh:
+            fh.write(b"\xde\xad\xbe\xef" * 64)
+    return channel, content_type, good_rows
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("fmt", sorted(_CHANNELS))
+def test_corrupt_file_whole_path_fails_loudly(tmp_path, fmt):
+    channel, content_type, _ = _corrupt_channel(tmp_path, fmt)
+    with pytest.raises(exc.UserError):
+        readers.get_data_matrix(channel, content_type)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("fmt", sorted(_CHANNELS))
+def test_corrupt_file_chunked_fail_policy(tmp_path, fmt):
+    channel, content_type, _ = _corrupt_channel(tmp_path, fmt)
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(channel, content_type)
+    assert err.value.reason == "bad_chunk"
+    assert streaming.quarantine_record() is None
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("fmt", sorted(_CHANNELS))
+def test_corrupt_file_chunked_skip_policy_quarantines(tmp_path, fmt):
+    channel, content_type, good_rows = _corrupt_channel(tmp_path, fmt)
+    bm = _ingest(
+        channel, content_type,
+        cfg_overrides={"action": "skip", "max_bad": 16},
+    )
+    assert bm.num_row == good_rows  # exactly the good files' rows survive
+    record = streaming.quarantine_record()
+    assert record is not None and record["chunks_skipped"] >= 1
+    # byte accounting covers every chunk unit (row-group/whole-file chunks
+    # carry the metadata byte estimate, not 0)
+    assert record["bytes_skipped"] > 0
+    assert all("zz-corrupt" in os.path.basename(c["file"])
+               for c in record["skipped_chunks"])
+    assert np.isfinite(bm.labels).all()
+
+
+@pytest.mark.chaos
+def test_truncated_files_both_paths(tmp_path):
+    """Mid-record truncation (the classic partial-download artifact) for a
+    text and a binary format, through both paths and both policies."""
+    for fmt in ("csv", "recordio-protobuf"):
+        content_type, make = _CHANNELS[fmt]
+        channel = str(tmp_path / ("trunc-" + fmt))
+        good_rows = make(channel, n_files=2)
+        # copy a good file and truncate it mid-record/mid-row
+        files = sorted(os.listdir(channel))
+        src = os.path.join(channel, files[0])
+        with open(src, "rb") as fh:
+            data = fh.read()
+        bad = os.path.join(channel, "zz-truncated" + os.path.splitext(files[0])[1])
+        if fmt == "recordio-protobuf":
+            # cut INSIDE a record, leaving its full header + a sliver of
+            # payload (a tail shorter than one header is silently ignored
+            # by the reader — that's not the corruption under test)
+            import struct as _struct
+
+            offset, cut = 0, None
+            while offset + 8 <= len(data):
+                _magic, length = _struct.unpack_from("<II", data, offset)
+                nxt = offset + 8 + ((length + 3) & ~3)
+                if nxt > len(data) // 2:
+                    cut = offset + 12
+                    break
+                offset = nxt
+            data = data[:cut]
+        with open(bad, "wb") as fh:
+            fh.write(data[: len(data) // 2 + 3] if fmt == "csv" else data)
+        if fmt == "csv":
+            # a cleanly-truncated csv (whole lines) still parses; chop the
+            # final row's fields instead
+            with open(bad, "rb") as fh:
+                txt = fh.read()
+            with open(bad, "wb") as fh:
+                fh.write(txt.rsplit(b",", 2)[0] + b"\n")
+            whole = readers.get_data_matrix(channel, content_type)
+            assert whole is not None  # pandas tolerates a short final row?
+        else:
+            with pytest.raises(exc.UserError):
+                readers.get_data_matrix(channel, content_type)
+        streaming.reset_ingest_state()
+        bm = _ingest(
+            channel, content_type,
+            cfg_overrides={"action": "skip", "max_bad": 16},
+        )
+        record = streaming.quarantine_record()
+        if fmt == "recordio-protobuf":
+            assert record is not None and record["chunks_skipped"] >= 1
+            # the truncated file's valid leading records are salvaged; only
+            # the chunk containing the truncation is quarantined
+            assert good_rows <= bm.num_row < good_rows + 300
+        else:
+            # the short csv row parses as a narrower line -> bad chunk OR a
+            # tolerated ragged row, but never a crash and never misaligned
+            assert bm.num_row >= good_rows
+
+
+# --------------------------------------------------- fault-injected chunks
+
+
+@pytest.mark.chaos
+def test_data_chunk_fault_skip_records_quarantine(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    channel = str(tmp_path / "csv")
+    total = _csv_channel(channel, n_files=3, rows=250)
+    faults.configure("data.chunk:error:injected corruption@2")
+    bm = _ingest(
+        channel, "text/csv", chunk_bytes=4096,
+        cfg_overrides={"action": "skip", "max_bad": 8},
+    )
+    record = streaming.quarantine_record()
+    assert record is not None and record["chunks_skipped"] == 1
+    entry = record["skipped_chunks"][0]
+    assert "injected corruption" in entry["error"]
+    assert entry["rows"] > 0  # best-effort newline row estimate
+    assert record["rows_skipped"] == entry["rows"]
+    assert bm.num_row == total - entry["rows"]
+
+
+@pytest.mark.chaos
+def test_data_chunk_fault_fail_policy_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    faults.configure("data.chunk:error:boom@2")
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(channel, "text/csv", cfg_overrides={"action": "fail"})
+    assert err.value.reason == "bad_chunk"
+
+
+@pytest.mark.chaos
+def test_data_chunk_fault_budget_exhaustion(tmp_path, monkeypatch):
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    faults.configure("data.chunk:error:rot@2+")
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(
+            channel, "text/csv", chunk_bytes=4096,
+            cfg_overrides={"action": "skip", "max_bad": 1},
+        )
+    assert err.value.reason == "budget_exceeded"
+
+
+@pytest.mark.chaos
+def test_data_chunk_fault_retry_then_success(tmp_path, monkeypatch):
+    """A transient blip (one failing attempt) is absorbed by the retry
+    policy: no quarantine, full row count."""
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("SM_IO_RETRY_BACKOFF_S", "0.0")
+    channel = str(tmp_path / "csv")
+    total = _csv_channel(channel)
+    faults.configure("data.chunk:error:blip@2")  # one hit only; retry passes
+    bm = _ingest(channel, "text/csv", cfg_overrides={"action": "fail"})
+    assert bm.num_row == total
+    assert streaming.quarantine_record() is None
+
+
+# ------------------------------------------------------- rank consistency
+
+
+@pytest.mark.chaos
+def test_two_rank_loopback_skip_consensus(tmp_path):
+    """Two loopback ranks shard one replicated channel; one rank's chunk is
+    corrupt. Both must agree on the identical quarantine and derive
+    identical cuts (the in-process twin of scripts/ingest_drill.py)."""
+    channel = str(tmp_path / "shared")
+    _csv_channel(channel, n_files=4, rows=300)
+    with open(os.path.join(channel, "zz-rot.csv"), "w") as fh:
+        fh.write("1.0,garbage,here\nnope\n")
+    hosts = ["algo-1", "algo-2"]
+    port = _free_port()
+    results = {}
+    errors = {}
+
+    def run(rank):
+        cfg = streaming.resolve_ingest_config()
+        cfg.chunk_bytes = 8192
+        cfg.action = "skip"
+        cfg.max_bad = 8
+        cfg.shard = True
+        cfg.port = port
+        cfg.timeout_s = 30.0
+        try:
+            results[rank] = streaming.ingest_channel(
+                channel, "text/csv", 256, config=cfg,
+                hosts=hosts, current_host=hosts[rank],
+                master_addr="127.0.0.1",
+            )
+        except Exception as e:  # surfaced below
+            errors[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    a, b = results[0], results[1]
+    # identical bin edges on every rank — the distributed-consistency core
+    assert len(a.cut_points) == len(b.cut_points)
+    for ca, cb in zip(a.cut_points, b.cut_points):
+        assert np.array_equal(ca, cb)
+    record = streaming.quarantine_record()
+    assert record is not None and record["chunks_skipped"] >= 1
+    assert all("zz-rot" in os.path.basename(c["file"])
+               for c in record["skipped_chunks"])
+    # sharded: the two ranks' shards partition the surviving rows
+    assert a.num_row + b.num_row == 4 * 300
+
+
+@pytest.mark.chaos
+def test_two_rank_plan_divergence_exits_consistently(tmp_path):
+    """Ranks sharding channels with different bytes must refuse (every rank
+    raises plan_divergence -> exit 85), never train misaligned."""
+    chan_a = str(tmp_path / "a")
+    chan_b = str(tmp_path / "b")
+    _csv_channel(chan_a, n_files=2, rows=200, seed=1)
+    _csv_channel(chan_b, n_files=2, rows=210, seed=9)
+    hosts = ["algo-1", "algo-2"]
+    port = _free_port()
+    errors = {}
+
+    def run(rank, channel):
+        cfg = streaming.resolve_ingest_config()
+        cfg.chunk_bytes = 4096
+        cfg.shard = True
+        cfg.port = port
+        cfg.timeout_s = 30.0
+        try:
+            streaming.ingest_channel(
+                channel, "text/csv", 256, config=cfg,
+                hosts=hosts, current_host=hosts[rank],
+                master_addr="127.0.0.1",
+            )
+        except Exception as e:
+            errors[rank] = e
+
+    threads = [
+        threading.Thread(target=run, args=(0, chan_a)),
+        threading.Thread(target=run, args=(1, chan_b)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert set(errors) == {0, 1}
+    for e in errors.values():
+        assert isinstance(e, streaming.IngestError) and e.reason == "plan_divergence"
+
+
+# ------------------------------------------------------------ gating/wiring
+
+
+def test_supports_streaming_gating():
+    ok, why, max_bin = streaming.supports_streaming({"objective": "reg:squarederror"})
+    assert ok and max_bin == 256
+    ok, _, mb = streaming.supports_streaming({"max_bin": 64})
+    assert ok and mb == 64
+    for cfg in (
+        {"booster": "gblinear"},
+        {"booster": "dart"},
+        {"tree_method": "exact"},
+        {"tree_method": "approx"},
+        {"process_type": "update"},
+    ):
+        ok, why, _ = streaming.supports_streaming(cfg)
+        assert not ok and why
+
+
+def test_forced_chunked_unsupported_config_raises(tmp_path, monkeypatch):
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    monkeypatch.setenv("SM_INGEST_MODE", "chunked")
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel, n_files=1)
+    with pytest.raises(exc.UserError):
+        at.get_validated_data_matrices(
+            channel, None, "text/csv", train_cfg={"booster": "gblinear"}
+        )
+
+
+def test_auto_mode_streams_large_single_host(tmp_path, monkeypatch):
+    from sagemaker_xgboost_container_tpu.data.binning import BinnedMatrix
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    monkeypatch.setenv("SM_INGEST_CHUNK_BYTES", "4096")  # tiny threshold
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    tr, va, tv = at.get_validated_data_matrices(
+        channel, None, "text/csv", train_cfg={"objective": "binary:logistic"}
+    )
+    assert isinstance(tr, BinnedMatrix) and va is None and tv is tr
+    # mode=whole pins the legacy readers regardless of size
+    monkeypatch.setenv("SM_INGEST_MODE", "whole")
+    tr2, _, _ = at.get_validated_data_matrices(
+        channel, None, "text/csv", train_cfg={"objective": "binary:logistic"}
+    )
+    assert isinstance(tr2, DataMatrix)
+
+
+def test_validation_channel_binned_with_train_cuts(tmp_path, monkeypatch):
+    from sagemaker_xgboost_container_tpu.data.binning import BinnedMatrix
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    monkeypatch.setenv("SM_INGEST_MODE", "chunked")
+    monkeypatch.setenv("SM_INGEST_CHUNK_BYTES", "4096")
+    tdir, vdir = str(tmp_path / "t"), str(tmp_path / "v")
+    _csv_channel(tdir, seed=0)
+    _csv_channel(vdir, n_files=1, seed=4)
+    tr, va, _ = at.get_validated_data_matrices(
+        tdir, vdir, "text/csv", train_cfg={"objective": "binary:logistic"}
+    )
+    assert isinstance(va, BinnedMatrix)
+    assert va.cut_points is tr.cut_points
+
+
+def test_ingest_error_converts_to_exit_85(tmp_path, monkeypatch):
+    """The sagemaker_train wiring: IngestError -> request_abort with
+    EXIT_INGEST_FAILED (the exit itself patched out, watchdog-test style)."""
+    from sagemaker_xgboost_container_tpu.training import watchdog
+
+    calls = []
+    monkeypatch.setattr(watchdog, "_exit", lambda code: calls.append(code))
+    watchdog._reset_abort_for_tests()
+    try:
+        streaming.abort_on_ingest_failure(
+            streaming.IngestError("budget_exceeded", "drill")
+        )
+    finally:
+        watchdog._reset_abort_for_tests()
+    assert calls == [85]
+
+
+def test_quarantine_stamped_into_model_manifest(tmp_path, monkeypatch):
+    """train_job stamps the agreed quarantine into the final model manifest
+    and writes ingest-quarantine.json beside the model."""
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    faults.configure("data.chunk:error:rot@2")
+    bm = _ingest(
+        channel, "text/csv", chunk_bytes=4096,
+        cfg_overrides={"action": "skip", "max_bad": 8},
+    )
+    faults.reset()
+    model_dir = str(tmp_path / "model")
+    at.train_job(
+        {"objective": "binary:logistic", "max_depth": 2, "num_round": 2},
+        bm, None, bm, model_dir, None, is_master=True,
+    )
+    manifest = json.load(open(os.path.join(model_dir, "xgboost-model.manifest")))
+    assert manifest["quarantine"]["chunks_skipped"] == 1
+    qdoc = json.load(open(os.path.join(model_dir, "ingest-quarantine.json")))
+    assert qdoc == manifest["quarantine"]
+
+
+def test_val_wider_than_train_refused(tmp_path):
+    tdir, vdir = str(tmp_path / "t"), str(tmp_path / "v")
+    _csv_channel(tdir, d=4)
+    _csv_channel(vdir, n_files=1, d=7)
+    bm = _ingest(tdir, "text/csv")
+    with pytest.raises(exc.UserError):
+        _ingest(vdir, "text/csv", cut_points=bm.cut_points)
+
+
+def test_empty_channel_returns_none(tmp_path):
+    assert _ingest(str(tmp_path / "missing"), "text/csv") is None
+
+
+# ----------------------------------------------------- review regressions
+
+
+def test_empty_file_counted_once_through_both_passes(tmp_path):
+    """validate_data_file_path AND the reader's own listing both skip the
+    empty file, but ingest_files_empty_total must count it exactly once."""
+    counter = REGISTRY.counter(
+        "ingest_files_empty_total", "Zero-byte channel files skipped during ingest"
+    )
+    channel = str(tmp_path / "once")
+    _csv_channel(channel, n_files=1)
+    open(os.path.join(channel, "aaa-empty.csv"), "w").close()
+    start = counter.value
+    readers.validate_data_file_path(channel, "text/csv")
+    readers.get_data_matrix(channel, "text/csv")
+    assert counter.value == start + 1
+
+
+def test_semantic_error_not_quarantined(tmp_path):
+    """csv_weights=1 against a channel with no weight column fails every
+    chunk identically — a customer data-format error, not corrupt bytes: it
+    must surface as UserError instead of burning the skip budget to 85."""
+    channel = str(tmp_path / "noweights")
+    _csv_channel(channel, n_files=2, d=1)  # label + one feature, no weights
+    with pytest.raises(exc.UserError) as err:
+        _ingest(
+            channel, "text/csv", csv_weights=1,
+            cfg_overrides={"action": "skip", "max_bad": 100},
+        )
+    assert "csv_weights" in str(err.value)
+    assert not isinstance(err.value, streaming.IngestError)
+    assert streaming.quarantine_record() is None
+
+
+def test_libsvm_sidecars_pin_whole_path(tmp_path, monkeypatch):
+    """.weight/.group companions are honored only by the whole-file readers:
+    auto mode falls back (weights actually load), forced chunked refuses."""
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    channel = str(tmp_path / "libsvm")
+    _libsvm_channel(channel, n_files=1, rows=400)
+    data_file = os.path.join(channel, "part-00.libsvm")
+    with open(data_file + ".weight", "w") as fh:
+        fh.write("\n".join(["1.5"] * 400) + "\n")
+    assert streaming.channel_has_sidecars("text/libsvm", channel)
+    assert not streaming.channel_has_sidecars("text/csv", channel)
+    assert not streaming.channel_has_sidecars("text/libsvm", None)
+
+    monkeypatch.setenv("SM_INGEST_CHUNK_BYTES", "4096")  # would stream
+    tr, _, _ = at.get_validated_data_matrices(
+        channel, None, "text/libsvm", train_cfg={"objective": "binary:logistic"}
+    )
+    assert isinstance(tr, DataMatrix)
+    assert tr.weights is not None and np.all(tr.weights == np.float32(1.5))
+
+    monkeypatch.setenv("SM_INGEST_MODE", "chunked")
+    with pytest.raises(exc.UserError) as err:
+        at.get_validated_data_matrices(
+            channel, None, "text/libsvm", train_cfg={"objective": "binary:logistic"}
+        )
+    assert "sidecar" in str(err.value)
+
+
+def test_rep_block_bin0_strictly_below_first_cut():
+    """float32 nextafter regression: a float64 nextafter(cut0, -inf) rounds
+    back to cut0 when stored into the float32 lookup (pre-NEP50 numpy),
+    flipping bin 0 to the wrong side of `v < cut[0]`."""
+    cuts = [np.array([0.25, 0.5], np.float32)]
+    bm = binning.BinnedMatrix(
+        np.array([[0], [1], [2]], np.uint8), cuts, 2,
+        labels=np.zeros(3, np.float32),
+    )
+    rep = bm.rep_block(0, 3)[:, 0]
+    assert rep.dtype == np.float32
+    assert rep[0] < np.float32(0.25)
+    assert rep[1] == np.float32(0.25) and rep[2] == np.float32(0.5)
+
+
+def test_parquet_zero_rowgroup_part_is_benign(tmp_path):
+    """An empty parquet part (ParquetWriter opened/closed, 0 row groups — a
+    common Spark artifact) must contribute nothing, not plan a phantom
+    row-group chunk that fails every rank to exit 85."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    channel = str(tmp_path / "pq")
+    expected = _parquet_channel(channel, n_files=1)
+    schema = pa.schema([(str(i), pa.float32()) for i in range(6)])
+    pq.ParquetWriter(os.path.join(channel, "part-00-empty.parquet"), schema).close()
+    bm = _ingest(channel, "application/x-parquet")
+    assert bm.num_row == expected
+    assert streaming.quarantine_record() is None
+
+
+def test_forced_chunked_pipe_or_configless_raises(monkeypatch):
+    """SM_INGEST_MODE=chunked refuses Pipe-mode / config-less jobs instead
+    of silently falling back to the whole-file readers."""
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    monkeypatch.setenv("SM_INGEST_MODE", "chunked")
+    with pytest.raises(exc.UserError):
+        at._streaming_plan({"objective": "binary:logistic"}, 1 << 30, False, True, 1)
+    with pytest.raises(exc.UserError):
+        at._streaming_plan(None, 1 << 30, False, False, 1)
+    # auto mode still falls back quietly for both
+    monkeypatch.setenv("SM_INGEST_MODE", "auto")
+    assert at._streaming_plan(None, 1 << 30, False, False, 1)[0] is False
+
+
+def test_staging_dirs_cleaned_up(tmp_path):
+    """Per-invocation chunked staging dirs must not accumulate in /tmp."""
+    import glob
+
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel, n_files=1)
+    assert _ingest(channel, "text/csv") is not None
+    pattern = "{}-chunked-{}-*".format(readers.STAGING_DIR, os.getpid())
+    assert glob.glob(pattern) == []
+
+
+def test_plan_io_failure_is_ingest_error(tmp_path, monkeypatch):
+    """A persistent IO failure during chunk planning lands in the exit-85
+    contract (IngestError) instead of escaping as a raw OSError."""
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel, n_files=1)
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    monkeypatch.setenv("SM_IO_RETRY_BACKOFF_S", "0.01")
+    real_getsize = os.path.getsize
+
+    def boom(path):
+        if str(path).endswith(".csv"):
+            raise OSError("channel blip")
+        return real_getsize(path)
+
+    monkeypatch.setattr(os.path, "getsize", boom)
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(channel, "text/csv")
+    assert err.value.reason == "plan_failed"
+
+
+def _qid_libsvm_channel(path, n_files=2, rows=120, d=4, seed=0):
+    os.makedirs(path, exist_ok=True)
+    for i in range(n_files):
+        labels, X = _rows(rows, d, seed + i)
+        lines = []
+        for r in range(rows):
+            toks = ["%g" % labels[r], "qid:%d" % (r // 10 + i * 1000)]
+            for f in range(d):
+                if not np.isnan(X[r, f]):
+                    toks.append("{}:{:.4f}".format(f, X[r, f]))
+            lines.append(" ".join(toks))
+        with open(os.path.join(path, "part-{:02d}.libsvm".format(i)), "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+
+def test_exchange_frame_budget_allows_large_sketches():
+    """The ingest allgather passes a frame budget sized to its payload —
+    a sketch reply beyond the 1 MiB control default must round-trip."""
+    from sagemaker_xgboost_container_tpu.parallel.distributed import (
+        MAX_CONTROL_FRAME_BYTES,
+        Cluster,
+    )
+
+    hosts = ["algo-1", "algo-2"]
+    port = _free_port()
+    # ASYMMETRIC on purpose: payload sizes are not uniform across ranks
+    # (a cuts-holding rank sends no sketch), so the bound must be a
+    # uniform cap, never derived from the local payload
+    payloads = [{"small": 1}, {"sketch": "x" * (MAX_CONTROL_FRAME_BYTES + 4096)}]
+    out, errs = {}, {}
+
+    def run(rank):
+        c = Cluster(hosts, hosts[rank], port=port)
+        c.master_host = "127.0.0.1"
+        try:
+            out[rank] = c.synchronize(
+                payloads[rank], timeout=30,
+                max_frame_bytes=streaming._INGEST_FRAME_CAP,
+            )
+        except Exception as e:
+            errs[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert out[0] == out[1] and len(out[0]) == 2
+
+
+def test_sharded_qid_channel_refused(tmp_path):
+    """SM_INGEST_SHARD round-robin would fragment qid query groups across
+    ranks — every rank must refuse identically (UserError, not a hang)."""
+    channel = str(tmp_path / "rank")
+    _qid_libsvm_channel(channel)
+    hosts = ["algo-1", "algo-2"]
+    port = _free_port()
+    errors = {}
+
+    def run(rank):
+        cfg = streaming.resolve_ingest_config()
+        cfg.chunk_bytes = 2048
+        cfg.shard = True
+        cfg.port = port
+        cfg.timeout_s = 30.0
+        try:
+            streaming.ingest_channel(
+                channel, "text/libsvm", 256, config=cfg,
+                hosts=hosts, current_host=hosts[rank],
+                master_addr="127.0.0.1",
+            )
+        except Exception as e:
+            errors[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert set(errors) == {0, 1}
+    for e in errors.values():
+        assert isinstance(e, exc.UserError) and "qid" in str(e)
+
+
+def test_unsharded_qid_channel_keeps_groups(tmp_path):
+    """Without sharding, chunked ingest preserves query groups — including
+    past a blank file whose chunk parses to zero rows (qids=None there must
+    not drop every group)."""
+    channel = str(tmp_path / "rankblank")
+    _qid_libsvm_channel(channel, n_files=1, rows=120)
+    with open(os.path.join(channel, "zz-blank.libsvm"), "w") as fh:
+        fh.write("\n" * 400)
+    bm = _ingest(channel, "text/libsvm", chunk_bytes=2048)
+    assert bm is not None and bm.groups is not None
+    assert int(np.sum(bm.groups)) == 120
+
+
+def test_local_preexchange_error_reaches_all_ranks(tmp_path):
+    """A rank that fails before the allgather (delimiter mismatch at plan
+    time) must still join it and broadcast the error — peers raise the SAME
+    UserError instead of stranding for SM_INGEST_TIMEOUT_S and exiting 85
+    as 'exchange_failed'."""
+    good = str(tmp_path / "good")
+    bad = str(tmp_path / "bad")
+    _csv_channel(good, n_files=2)
+    os.makedirs(bad)
+    with open(os.path.join(bad, "part-00.csv"), "w") as fh:
+        fh.write("1.0,2.0,3.0\n0.0,1.0,2.0\n")
+    with open(os.path.join(bad, "part-01.csv"), "w") as fh:
+        fh.write("1.0;2.0;3.0\n0.0;1.0;2.0\n")  # delimiter mismatch
+    hosts = ["algo-1", "algo-2"]
+    port = _free_port()
+    errors = {}
+
+    def run(rank, channel):
+        cfg = streaming.resolve_ingest_config()
+        cfg.chunk_bytes = 4096
+        cfg.port = port
+        cfg.timeout_s = 30.0
+        try:
+            streaming.ingest_channel(
+                channel, "text/csv", 256, config=cfg,
+                hosts=hosts, current_host=hosts[rank],
+                master_addr="127.0.0.1",
+            )
+        except Exception as e:
+            errors[rank] = e
+
+    threads = [
+        threading.Thread(target=run, args=(0, good)),
+        threading.Thread(target=run, args=(1, bad)),
+    ]
+    start = __import__("time").monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    elapsed = __import__("time").monotonic() - start
+    assert set(errors) == {0, 1}
+    for e in errors.values():
+        assert isinstance(e, exc.UserError) and "delimiter" in str(e).lower()
+        assert not isinstance(e, streaming.IngestError)
+    assert elapsed < 25  # agreed through the exchange, not a timeout
+
+
+def test_cut_supplied_channel_reads_chunks_once(tmp_path, monkeypatch):
+    """Validation channels (cuts pre-agreed) bin during pass 1 and assemble
+    from the cached blocks: each chunk is read+parsed exactly once (the
+    train channel still needs both passes), and the result is bit-identical
+    to binning the whole-file parse with the same cuts."""
+    tdir, vdir = str(tmp_path / "t"), str(tmp_path / "v")
+    _csv_channel(tdir, seed=0)
+    _csv_channel(vdir, n_files=2, seed=9)
+    bm = _ingest(tdir, "text/csv")
+
+    calls = []
+    real = streaming._parse_chunk
+
+    def counted(plan, chunk, csv_weights):
+        calls.append((chunk.file, chunk.start, chunk.end))
+        return real(plan, chunk, csv_weights)
+
+    monkeypatch.setattr(streaming, "_parse_chunk", counted)
+    vm = _ingest(vdir, "text/csv", cut_points=bm.cut_points)
+    assert len(calls) == len(set(calls))  # no chunk parsed twice
+    whole = binning.bin_matrix(
+        readers.get_data_matrix(vdir, "text/csv"), 256, cut_points=bm.cut_points
+    )
+    assert np.array_equal(vm.bins, whole.bins)
+    assert np.array_equal(vm.get_label(), whole.labels)
+
+
+def test_bad_chunk_errors_name_offending_chunk(tmp_path, monkeypatch):
+    """The exit-85 runbook promises the abort detail names the first
+    offending chunk — both the fail-policy and budget-exceeded messages
+    must carry file[start:end), not just the exception text."""
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    faults.configure("data.chunk:error:rot@2")
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(channel, "text/csv", cfg_overrides={"action": "fail"})
+    assert err.value.reason == "bad_chunk"
+    assert "part-00.csv[" in str(err.value) and "rot" in str(err.value)
+
+    faults.reset()
+    streaming.reset_ingest_state()
+    faults.configure("data.chunk:error:decay@2+")
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(
+            channel, "text/csv", chunk_bytes=4096,
+            cfg_overrides={"action": "skip", "max_bad": 1},
+        )
+    assert err.value.reason == "budget_exceeded"
+    assert "first: part-00.csv[" in str(err.value)
+
+
+def test_second_job_ingest_starts_with_fresh_state(tmp_path, monkeypatch):
+    """The job wiring resets the module-global quarantine/budget state: a
+    second same-process training run (local mode, elastic-reform replay)
+    must not inherit the first run's consumed skip budget or duplicate its
+    quarantine entries into the new model's manifest."""
+    from sagemaker_xgboost_container_tpu.data.binning import BinnedMatrix
+    from sagemaker_xgboost_container_tpu.training import algorithm_train as at
+
+    monkeypatch.setenv("SM_IO_RETRY_ATTEMPTS", "1")
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel)
+    faults.configure("data.chunk:error:rot@2")
+    _ingest(
+        channel, "text/csv", chunk_bytes=4096,
+        cfg_overrides={"action": "skip", "max_bad": 8},
+    )
+    faults.reset()
+    assert streaming.quarantine_record() is not None  # first run skipped
+
+    monkeypatch.setenv("SM_INGEST_MODE", "chunked")
+    monkeypatch.setenv("SM_INGEST_CHUNK_BYTES", "4096")
+    tr, _, _ = at.get_validated_data_matrices(
+        channel, None, "text/csv", train_cfg={"objective": "binary:logistic"}
+    )
+    assert isinstance(tr, BinnedMatrix)
+    # the clean second job carries no quarantine from the first
+    assert streaming.quarantine_record() is None
+
+
+def test_staging_io_failure_is_ingest_error(tmp_path, monkeypatch):
+    """An OSError from staging/listing (outside the ingest.plan retry site)
+    must land in the exit-85 contract and ride the pre-exchange error
+    broadcast, not escape raw and strand peers as 'exchange_failed'."""
+    channel = str(tmp_path / "csv")
+    _csv_channel(channel, n_files=1)
+
+    def boom(data_path, staging_dir=None):
+        raise OSError("disk full staging channel")
+
+    monkeypatch.setattr(streaming.readers, "stage_input_files", boom)
+    with pytest.raises(streaming.IngestError) as err:
+        _ingest(channel, "text/csv")
+    assert err.value.reason == "plan_failed"
+    assert "disk full" in str(err.value)
+
+
+def test_compress_summary_is_a_hard_cap():
+    """SM_INGEST_SKETCH_SIZE / SM_INGEST_WIRE_SKETCH document a per-feature
+    cap: the compressed summary must never exceed it (the extremes joining
+    the quantile picks used to overshoot to cap+2), while conserving total
+    weight and keeping both extremes."""
+    rng = np.random.RandomState(3)
+    values = np.unique(rng.rand(5000).astype(np.float32))
+    weights = rng.rand(len(values)).astype(np.float64)
+    for cap in (2, 3, 10, 64, 512):
+        cv, cw = streaming._compress_summary(values, weights, cap)
+        assert len(cv) <= cap
+        assert cv[0] == values[0] and cv[-1] == values[-1]
+        assert abs(cw.sum() - weights.sum()) < 1e-9
+    # below the cap: identity (the bitwise whole-path parity regime)
+    cv, cw = streaming._compress_summary(values, weights, len(values))
+    assert cv is values and cw is weights

@@ -305,6 +305,8 @@ def _calibrated_comm_ms(mesh, hist_comm, plan_key):
             x, "data", scatter_dimension=1, tiled=True
         )
 
+    from ..ops.histogram import MERGE_COLLECTIVES_PER_SCAN
+
     total_s = 0.0
     timed = {}
     for kind, shape, count in plan_key:
@@ -313,6 +315,8 @@ def _calibrated_comm_ms(mesh, hist_comm, plan_key):
             if kind == "hist" and hist_comm == "reduce_scatter":
                 fn, out_spec = scatter_fn, P(None, "data", None)
             else:
+                # totals and winner-merge entries are psum-class [W]
+                # collectives under both lowerings
                 fn, out_spec = psum_fn, P()
             # graftlint: disable=trace-uncached-jit — calibration-scope: lru_cached module factory, one standalone collective timing per distinct (mesh, plan shape, impl) per process, off the round path
             mapped = jax.jit(
@@ -332,8 +336,11 @@ def _calibrated_comm_ms(mesh, hist_comm, plan_key):
                 jax.block_until_ready(mapped(x))
                 best = min(best, time.perf_counter() - t0)
             timed[key] = best
-        # one timing covers one tensor; the round moves G and H
-        total_s += timed[key] * 2 * count
+        # one timing covers one tensor: hist/totals move G and H (2 per
+        # count); a winner-merge scan issues MERGE_COLLECTIVES_PER_SCAN
+        # [W]-shaped collectives per count
+        per_count = MERGE_COLLECTIVES_PER_SCAN if kind == "merge" else 2
+        total_s += timed[key] * per_count * count
     return total_s * 1000.0
 
 
@@ -386,6 +393,12 @@ class _TrainingSession:
         has_feval=False,
         hist_knobs=None,
     ):
+        # persistent XLA compile cache (GRAFT_COMPILE_CACHE_DIR): armed
+        # before anything in this session can trigger a compile, resolved
+        # once per process like every other session knob
+        from ..utils.compile_cache import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache()
         self.config = config
         self.objective = forest.objective()
         self.num_group = self.objective.num_output_group
@@ -412,18 +425,12 @@ class _TrainingSession:
         # the session on a smaller mesh but MUST train under the same knobs
         # as the generation it resumes (no mid-job env drift).
         self.hist_knobs = hist_knobs if hist_knobs is not None else resolve_hist_knobs()
-        if self.hist_comm == "reduce_scatter" and self.has_feature_axis:
-            # reduce_scatter re-shards the SPLIT SCAN over the data axis;
-            # with a feature axis the scan is already column-sharded and the
-            # two slicings would compose into a 2-D winner merge we don't
-            # implement — refuse loudly rather than silently mis-merge.
-            raise exc.UserError(
-                "GRAFT_HIST_COMM=reduce_scatter applies to the data axis "
-                "only and does not compose with a 'feature' mesh axis. On a "
-                "2-D (data x feature) mesh use GRAFT_HIST_COMM=psum (the "
-                "feature axis already shards the split scan), or drop the "
-                "feature axis to use reduce_scatter."
-            )
+        # reduce_scatter composes with a 'feature' mesh axis: each feature
+        # shard's local histograms psum_scatter along the DATA axis, every
+        # device gain-scans only its doubly-sharded d_local/n_data_shards
+        # column block, and winners merge hierarchically (data-axis
+        # sub-slice merge, then the feature-axis merge) — bit-identical to
+        # the psum lowering on the same mesh (ops/tree_build.build_tree).
         # multi-host: every process holds its own row shard; device arrays are
         # assembled into global arrays over the whole mesh
         self.is_multiprocess = mesh is not None and jax.process_count() > 1
@@ -1182,8 +1189,11 @@ class _TrainingSession:
         cfg = self.config
         if self.mesh is None or self.n_data_shards <= 1:
             return [], 0
-        # columns each data shard histograms (whole width unless a feature
-        # axis splits them; reduce_scatter never coexists with one)
+        # columns each data shard histograms: the whole width, unless a
+        # feature axis splits them — under the 2-D reduce_scatter lowering
+        # round_comm_plan further pads/scatters this local width to
+        # d_local/n_data_shards per device and adds the winner-merge
+        # entries of the hierarchical two-axis merge
         d_local = self.d_pad // self.n_feature_shards
         num_bins = self.train_binned.num_bins
         # the builders gate subtraction on the FULL feature width under both
@@ -1777,6 +1787,11 @@ def train(
     snapshot so the rebuilt (smaller-mesh) session trains under identical
     kernel choices.
     """
+    from ..utils.compile_cache import maybe_enable_compile_cache
+
+    # armed here too so every booster path (gblinear, dart, update) gets
+    # the persistent compile cache, not just _TrainingSession builders
+    maybe_enable_compile_cache()
     config = TrainConfig(params)
     callbacks = list(callbacks or [])
 

@@ -227,6 +227,10 @@ def scatter_histograms(G, H, axis_name, axis_size):
     for this shard's contiguous feature slice. Values are the same sums the
     full psum would produce for those columns (XLA reduces both collectives
     in rank order), so split decisions downstream stay bit-identical.
+    ``d`` is whatever column width the caller histograms — the full matrix
+    on a 1-D mesh, or a feature shard's d_local slice on a 2-D (data x
+    feature) mesh, where the per-shard padding of d_local keeps the
+    doubly-sharded slice boundary static.
     """
     d = G.shape[1]
     d_pad = padded_feature_width(d, axis_size)
@@ -251,6 +255,13 @@ def _wire_ratio(comm, axis_size):
     return 2.0 * frac if comm == "psum" else frac
 
 
+# data-axis collectives per winner-merge scan batch under reduce_scatter:
+# broadcast_node_totals psums g and h (2), combine_splits_across_shards
+# runs pmax(gain), pmin(tie-break candidate) and 3 selection psums
+# (feature, bin, default_left) — 7 [W]-shaped collectives in total
+MERGE_COLLECTIVES_PER_SCAN = 7
+
+
 def round_comm_plan(
     grow_policy,
     max_depth,
@@ -265,11 +276,20 @@ def round_comm_plan(
     """Static per-round collective plan for the data axis.
 
     Returns ``(entries, bytes_per_round)`` where each entry is
-    ``{"kind": "hist"|"totals", "shape": local payload shape, "count": n,
-    "bytes": wire bytes for all n collectives}``. ``bytes_per_round`` feeds
-    the ``hist_comm_bytes_total`` counter; the entry list feeds the
-    latency calibration (one timing per distinct shape). Payload = G and H
-    f32 tensors; wire bytes = payload x ring ratio (_wire_ratio).
+    ``{"kind": "hist"|"totals"|"merge", "shape": local payload shape,
+    "count": n, "bytes": wire bytes for all n collectives}``.
+    ``bytes_per_round`` feeds the ``hist_comm_bytes_total`` counter; the
+    entry list feeds the latency calibration (one timing per distinct
+    shape). ``hist`` entries carry the G and H f32 histogram pair (wire
+    bytes = payload x ring ratio, _wire_ratio); ``d`` is the width each
+    data shard histograms — the feature-shard-LOCAL width on a 2-D mesh,
+    which reduce_scatter pads and scatters to d/axis_size per device.
+    Under reduce_scatter the plan also carries the ``merge`` entries of
+    the winner merge (MERGE_COLLECTIVES_PER_SCAN [W]-shaped psum-class
+    collectives per gain-scan: the node-totals broadcast plus the
+    cross-shard split combine), so ``hist_comm_bytes_total`` and the
+    latency calibration stay truthful for the scattered lowering — 1-D
+    and the 2-D (data x feature) composition alike.
     """
     if axis_size <= 1:
         return [], 0
@@ -277,16 +297,21 @@ def round_comm_plan(
     ratio = _wire_ratio(comm, axis_size)
     psum_ratio = _wire_ratio("psum", axis_size)
     hist_widths = []
+    merge_widths = []   # winner-merge scan widths (reduce_scatter only)
     totals = []
     if grow_policy == "lossguide":
         hist_widths.append((1, 1))                       # root
+        merge_widths.append((1, 1))
         if max_leaves > 1:
             w = 1 if subtract else 2
             hist_widths.append((w, max_leaves - 1))      # per split step
+            merge_widths.append((2, max_leaves - 1))     # both fresh children
     else:
         hist_widths.append((1, 1))                       # level 0
+        merge_widths.append((1, 1))
         for level in range(1, max_depth):
             hist_widths.append((2 ** (level - 1) if subtract else 2**level, 1))
+            merge_widths.append((2**level, 1))           # full level scan
         totals.append((2**max_depth, 1))                 # last-level node totals
     entries = []
     total_bytes = 0.0
@@ -306,6 +331,14 @@ def round_comm_plan(
             {"kind": "totals", "shape": (W,), "count": count, "bytes": b}
         )
         total_bytes += b
+    if comm == "reduce_scatter":
+        for W, count in merge_widths:
+            count *= trees_per_round
+            b = MERGE_COLLECTIVES_PER_SCAN * W * 4 * psum_ratio * count
+            entries.append(
+                {"kind": "merge", "shape": (W,), "count": count, "bytes": b}
+            )
+            total_bytes += b
     return entries, int(total_bytes)
 
 

@@ -97,12 +97,10 @@ def build_tree_lossguide(
     max_nodes = 2 * max_leaves - 1
     depth_cap = max_depth if max_depth > 0 else max_leaves
     reduce_scatter = hist_comm == "reduce_scatter" and axis_name is not None
-    if reduce_scatter and feature_axis_name is not None:
-        raise ValueError(
-            "GRAFT_HIST_COMM=reduce_scatter shards the split scan over the "
-            "data axis and cannot compose with a 'feature' mesh axis; use "
-            "GRAFT_HIST_COMM=psum on 2-D (data x feature) meshes."
-        )
+    # ``d`` is the feature-shard-LOCAL width on a 2-D (data x feature)
+    # mesh, so the reduce_scatter slicing composes with the feature axis —
+    # see ops.tree_build.build_tree: each device scans a doubly-sharded
+    # d_local/n_data_shards block and winners merge hierarchically.
     d_scan = padded_feature_width(d, n_data_shards) // n_data_shards if reduce_scatter else d
     data_shard = jax.lax.axis_index(axis_name) if reduce_scatter else None
 
@@ -129,8 +127,10 @@ def build_tree_lossguide(
     def _combine(splits):
         if reduce_scatter:
             # data-axis winner merge (shared with the feature-axis path);
-            # totals were broadcast from shard 0 before the scan
-            return combine_splits_across_shards(
+            # totals were broadcast from data-shard 0 before the scan. On a
+            # 2-D mesh this yields feature-shard-local ids, globalized by
+            # the feature-axis merge below (hierarchical two-axis merge).
+            splits = combine_splits_across_shards(
                 splits, data_shard, d_scan, axis_name
             )
         if feature_axis_name is None:

@@ -93,6 +93,13 @@ def broadcast_node_totals(G, H, shard, axis_name):
     so totals must come from the shard owning global feature 0 and
     psum-broadcast (adding exact zeros) BEFORE the gain scan; every shard's
     gains then use totals bit-identical to the psum lowering's.
+
+    On a 2-D (data x feature) mesh ``shard``/``axis_name`` are the DATA
+    shard/axis and the broadcast runs within each feature shard: its
+    data-shard 0 holds the feature shard's local column 0 after the
+    scatter — exactly the column the psum lowering's scan derives totals
+    from on that feature shard — so the composed lowering's gains stay
+    bit-identical to psum on the same mesh.
     """
     own0 = shard == 0
     g = jnp.where(own0, G[:, 0, :].sum(axis=-1), 0.0)

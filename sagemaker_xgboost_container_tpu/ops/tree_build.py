@@ -132,9 +132,16 @@ def build_tree(
     histograms for only its d/n_data_shards feature slice, scans that slice,
     and the per-shard winners merge through the same
     combine_splits_across_shards machinery the feature axis uses (the data
-    axis IS a feature axis for the duration of the split scan). Tie-breaking
-    (max gain, lowest global feature id) and node totals are bit-identical
-    to the psum lowering, so committed trees match bitwise.
+    axis IS a feature axis for the duration of the split scan). On a 2-D
+    (data x feature) mesh the two compose: ``bins`` already holds only this
+    feature shard's d_local columns, the psum_scatter slices those again
+    along the data axis (each device scans d_local/n_data_shards columns),
+    and winners merge hierarchically — the data-axis merge produces
+    feature-shard-local ids (offset ``data_shard * d_scan``), which the
+    existing feature-axis merge then globalizes (offset
+    ``feat_shard * d_local``). Tie-breaking (max gain, lowest global
+    feature id) and node totals are bit-identical to the psum lowering on
+    the same mesh, so committed trees match bitwise.
 
     knobs: the session's ``ops.histogram.HistKnobs`` snapshot (trace-safety:
     the traced build must not read env; None falls back to per-knob env
@@ -142,13 +149,11 @@ def build_tree(
     """
     n, d = bins.shape
     reduce_scatter = hist_comm == "reduce_scatter" and axis_name is not None
-    if reduce_scatter and feature_axis_name is not None:
-        raise ValueError(
-            "GRAFT_HIST_COMM=reduce_scatter shards the split scan over the "
-            "data axis and cannot compose with a 'feature' mesh axis; use "
-            "GRAFT_HIST_COMM=psum on 2-D (data x feature) meshes."
-        )
-    # reduce_scatter: the scan runs on this shard's feature slice only
+    # reduce_scatter: the scan runs on this shard's feature slice only.
+    # ``d`` is already the feature-shard-LOCAL width on a 2-D (data x
+    # feature) mesh, so the two slicings compose: each device scans a
+    # doubly-sharded d_local/n_data_shards block and the winners merge
+    # hierarchically (data-axis sub-slice merge, then the feature axis).
     d_scan = padded_feature_width(d, n_data_shards) // n_data_shards if reduce_scatter else d
     data_shard = jax.lax.axis_index(axis_name) if reduce_scatter else None
     max_nodes = max_nodes_for_depth(max_depth)

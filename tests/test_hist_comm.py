@@ -3,11 +3,15 @@
 The reduce_scatter lowering (ops/histogram.scatter_histograms) replaces the
 full-histogram psum with ``lax.psum_scatter`` along the data axis: each
 device aggregates and scans only its d/axis_size feature slice and the
-per-shard winners merge through combine_splits_across_shards. The contract
-is BIT-IDENTICAL committed trees versus the psum lowering — same argmax,
-same tie-breaking (max gain, lowest global feature id), same node totals
-(broadcast_node_totals) — at roughly half the collective wire bytes and
-1/axis_size the split-scan FLOPs.
+per-shard winners merge through combine_splits_across_shards. On a 2-D
+(data x feature) mesh the slicing composes with the feature axis: each
+feature shard's local histograms scatter along the data axis, devices scan
+doubly-sharded d_local/n_data_shards blocks, and winners merge
+hierarchically (data-axis sub-slice merge, then the feature-axis merge).
+The contract is BIT-IDENTICAL committed trees versus the psum lowering on
+the same mesh — same argmax, same tie-breaking (max gain, lowest global
+feature id), same node totals (broadcast_node_totals) — at roughly half
+the collective wire bytes and 1/axis_size the split-scan FLOPs.
 
 Runs on the conftest 8-virtual-device CPU mesh (real SPMD partitioning +
 collectives without TPU hardware).
@@ -23,10 +27,10 @@ from jax.sharding import Mesh
 from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
 from sagemaker_xgboost_container_tpu.models import train
 from sagemaker_xgboost_container_tpu.ops.histogram import (
+    MERGE_COLLECTIVES_PER_SCAN,
     padded_feature_width,
     round_comm_plan,
 )
-from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
 
 _TREE_FIELDS = (
     "feature",
@@ -324,19 +328,102 @@ def test_reduce_scatter_scan_runs_on_feature_slice(monkeypatch, mesh8):
     assert seen and all(w == d for w in seen), seen
 
 
+def _mesh2d(shape):
+    devices = np.array(jax.devices()[:8]).reshape(shape)
+    return Mesh(devices, axis_names=("data", "feature"))
+
+
+_BUILDER_PARAMS_2D = {
+    "hist": {"objective": "binary:logistic", "max_depth": 3, "seed": 4},
+    "lossguide": {
+        "objective": "binary:logistic",
+        "grow_policy": "lossguide",
+        "max_leaves": 5,
+        "max_depth": 0,
+        "seed": 4,
+    },
+}
+
+
 @pytest.mark.multichip
-def test_reduce_scatter_refuses_2d_mesh(monkeypatch):
-    devices = np.array(jax.devices()[:8]).reshape(4, 2)
-    mesh2d = Mesh(devices, axis_names=("data", "feature"))
-    X, y = _data(d=8, seed=6, missing=0)
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+def test_2d_mesh_equivalence_matrix(monkeypatch, mesh_shape):
+    """2-D (data x feature) composition of the reduce_scatter lowering:
+    every cell of (builder x subtraction x K∈{1,4} x overlap on/off) must
+    commit packed trees AND predictions u32-view identical to the psum
+    lowering on the same mesh — the PR-4 bit-identity contract extended to
+    the two-axis winner merge (data-axis sub-slice merge, then the
+    feature-axis merge, global feature ids offset per shard)."""
+    mesh = _mesh2d(mesh_shape)
+    X, y = _data(n=256, d=9, seed=21)
+    for builder, params in _BUILDER_PARAMS_2D.items():
+        for subtract in ("1", "0"):
+            monkeypatch.setenv("GRAFT_HIST_SUBTRACT", subtract)
+            monkeypatch.setenv("GRAFT_HIST_OVERLAP", "1")
+            monkeypatch.setenv("GRAFT_HIST_COMM", "psum")
+            reference = train(
+                dict(params), DataMatrix(X, labels=y), num_boost_round=4,
+                mesh=mesh,
+            )
+            pr = np.asarray(reference.predict(X), np.float32)
+            monkeypatch.setenv("GRAFT_HIST_COMM", "reduce_scatter")
+            for k_rounds in (1, 4):
+                for overlap in ("1", "0"):
+                    monkeypatch.setenv("GRAFT_HIST_OVERLAP", overlap)
+                    f = train(
+                        dict(params, _rounds_per_dispatch=k_rounds),
+                        DataMatrix(X, labels=y),
+                        num_boost_round=4,
+                        mesh=mesh,
+                    )
+                    cell = (mesh_shape, builder, subtract, k_rounds, overlap)
+                    assert f.num_boosted_rounds == 4, cell
+                    _assert_forests_bitwise(reference, f)
+                    pf = np.asarray(f.predict(X), np.float32)
+                    assert np.array_equal(
+                        pr.view(np.uint32), pf.view(np.uint32)
+                    ), cell
+
+
+@pytest.mark.multichip
+def test_2d_scan_runs_on_doubly_sharded_slice(monkeypatch):
+    """The 2-D reduce_scatter scan provably covers exactly
+    d_local/n_data_shards columns per device (vs the feature-shard-local
+    d_local under psum): record the histogram widths find_best_splits
+    traces under shard_map."""
+    from sagemaker_xgboost_container_tpu.ops import tree_build
+
+    seen = []
+    orig = tree_build.find_best_splits
+
+    def recorder(G, H, num_cuts, **kw):
+        seen.append(int(G.shape[1]))
+        return orig(G, H, num_cuts, **kw)
+
+    monkeypatch.setattr(tree_build, "find_best_splits", recorder)
+    d, n_data, n_feat = 11, 4, 2
+    mesh = _mesh2d((n_data, n_feat))
+    d_local = padded_feature_width(d, n_feat) // n_feat            # 6
+    d_slice = padded_feature_width(d_local, n_data) // n_data      # 2
+    X, y = _data(d=d, seed=25)
     monkeypatch.setenv("GRAFT_HIST_COMM", "reduce_scatter")
-    with pytest.raises(exc.UserError, match="psum"):
-        train(
-            {"objective": "binary:logistic", "max_depth": 3},
-            DataMatrix(X, labels=y),
-            num_boost_round=1,
-            mesh=mesh2d,
-        )
+    train(
+        {"objective": "binary:logistic", "max_depth": 3},
+        DataMatrix(X, labels=y),
+        num_boost_round=1,
+        mesh=mesh,
+    )
+    assert seen and all(w == d_slice for w in seen), seen
+
+    seen.clear()
+    monkeypatch.setenv("GRAFT_HIST_COMM", "psum")
+    train(
+        {"objective": "binary:logistic", "max_depth": 3},
+        DataMatrix(X, labels=y),
+        num_boost_round=1,
+        mesh=mesh,
+    )
+    assert seen and all(w == d_local for w in seen), seen
 
 
 @pytest.mark.multichip
@@ -388,6 +475,48 @@ def test_round_comm_plan_formula():
     # single shard: no collectives
     entries, zero = round_comm_plan("depthwise", 6, 0, d, B, 1, "psum", False)
     assert entries == [] and zero == 0
+
+
+def test_round_comm_plan_2d_formula():
+    """Plan formula for the 2-D lowering: fed the feature-shard-LOCAL width
+    (what each data shard histograms on a data x feature mesh), the
+    reduce_scatter plan's data-axis hist wire bytes must stay < 0.75x the
+    psum plan's — the PR-4 bound, now on 2-D — and the plan must carry the
+    winner-merge psum entries of the hierarchical two-axis merge."""
+    d_local, B, p_data = 6, 257, 4   # e.g. d=11 on a (4 x 2) mesh
+    e_ps, ps = round_comm_plan(
+        "depthwise", 5, 0, d_local, B, p_data, "psum", False
+    )
+    e_rs, rs = round_comm_plan(
+        "depthwise", 5, 0, d_local, B, p_data, "reduce_scatter", False
+    )
+    hist_ps = sum(e["bytes"] for e in e_ps if e["kind"] == "hist")
+    hist_rs = sum(e["bytes"] for e in e_rs if e["kind"] == "hist")
+    assert hist_ps > 0 and hist_rs > 0
+    assert hist_rs < 0.75 * hist_ps
+    assert rs < 0.75 * ps  # the bound holds with merge entries included
+    d_pad = padded_feature_width(d_local, p_data)  # 8
+    assert abs(hist_rs / hist_ps - d_pad / (2.0 * d_local)) < 0.02
+    # hist payloads are the pre-scatter padded-local width; the per-device
+    # scattered scan slice is d_pad / p_data columns
+    assert all(
+        e["shape"][1] == d_pad for e in e_rs if e["kind"] == "hist"
+    )
+    assert d_pad % p_data == 0 and d_pad // p_data == 2
+    # winner-merge entries: reduce_scatter only, one [W] psum-class entry
+    # per gain-scan width, MERGE_COLLECTIVES_PER_SCAN collectives each
+    merge = [e for e in e_rs if e["kind"] == "merge"]
+    assert merge and all(len(e["shape"]) == 1 for e in merge)
+    assert [e["shape"][0] for e in merge] == [1, 2, 4, 8, 16]
+    ratio = (p_data - 1) / p_data
+    assert merge[0]["bytes"] == MERGE_COLLECTIVES_PER_SCAN * 1 * 4 * 2 * ratio
+    assert not [e for e in e_ps if e["kind"] == "merge"]
+    # lossguide: root merge (W=1) + one both-children merge (W=2) per step
+    e_lg, _ = round_comm_plan(
+        "lossguide", 0, 6, d_local, B, p_data, "reduce_scatter", True
+    )
+    lg_merge = [e for e in e_lg if e["kind"] == "merge"]
+    assert [(e["shape"][0], e["count"]) for e in lg_merge] == [(1, 1), (2, 5)]
 
 
 def test_hist_comm_env_validation(monkeypatch):

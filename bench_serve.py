@@ -23,6 +23,83 @@ import numpy as np  # noqa: E402
 
 N_REQUESTS = int(os.getenv("BENCH_SERVE_REQUESTS", "300"))
 CHURN_CYCLES = int(os.getenv("BENCH_SERVE_CHURN_CYCLES", "3"))
+STEADY_SECONDS = float(os.getenv("BENCH_SERVE_STEADY_S", "3"))
+
+
+def _steady_leg(model_dir, single_payload):
+    """Steady-state RPS/SLO leg (ROADMAP item 3's "steady-state RPS/SLO
+    line"): a fresh server with the SLO window armed, two client threads at
+    sustained load, reporting throughput and the window's own p95 /
+    violation-rate view -> (steady_rps, slo_p95_ms, slo_violation_rate).
+
+    The SLO target honors the operator's SM_SLO_P95_MS; unset, it defaults
+    to 50 ms so the leg always exercises the violation accounting.
+    """
+    import urllib.request
+    from wsgiref.simple_server import make_server
+
+    from sagemaker_xgboost_container_tpu.serving.app import ScoringService, make_app
+    from sagemaker_xgboost_container_tpu.serving.server import (
+        _QuietHandler,
+        _ThreadedWSGIServer,
+    )
+    from sagemaker_xgboost_container_tpu.telemetry import slo
+
+    prior_target = os.environ.get(slo.SLO_P95_ENV)
+    os.environ.setdefault(slo.SLO_P95_ENV, "50")
+    slo._reset_for_tests()  # fresh window regardless of earlier legs
+    app = make_app(ScoringService(model_dir))  # instrument_wsgi arms the SLO
+    httpd = make_server(
+        "127.0.0.1", 0, app,
+        server_class=_ThreadedWSGIServer, handler_class=_QuietHandler,
+    )
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:{}/invocations".format(port)
+    stop = threading.Event()
+    counts = []
+    lock = threading.Lock()
+
+    def client():
+        n = 0
+        while not stop.is_set():
+            req = urllib.request.Request(
+                url, data=single_payload, method="POST",
+                headers={"Content-Type": "text/csv"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    resp.read()
+                    n += 1
+            except Exception:
+                pass
+        with lock:
+            counts.append(n)
+
+    clients = [threading.Thread(target=client, daemon=True) for _ in range(2)]
+    t0 = time.perf_counter()
+    for t in clients:
+        t.start()
+    time.sleep(STEADY_SECONDS)
+    stop.set()
+    for t in clients:
+        t.join(timeout=15)
+    elapsed = time.perf_counter() - t0
+    httpd.shutdown()
+    httpd.server_close()
+    window = slo.active_window()
+    snap = window.snapshot() if window is not None else {}
+    slo._reset_for_tests()
+    if prior_target is None:
+        os.environ.pop(slo.SLO_P95_ENV, None)
+    else:
+        os.environ[slo.SLO_P95_ENV] = prior_target
+    total = sum(counts)
+    return (
+        round(total / elapsed, 1) if elapsed > 0 else 0.0,
+        snap.get("p95_ms", 0.0),
+        snap.get("violation_rate", 0.0),
+    )
 
 
 def _churn_leg(model_dir, single_payload):
@@ -182,7 +259,9 @@ def main():
     httpd.shutdown()
     httpd.server_close()
 
-    # churn leg: p95 + error rate across rolling graceful-restart cycles
+    # steady-state leg: sustained RPS + the SLO window's own p95/violation
+    # view (ROADMAP item 3), then the churn leg's rolling restarts
+    steady_rps, slo_p95_ms, slo_violation_rate = _steady_leg(model_dir, single)
     churn_p95_ms, churn_error_rate, churn_requests = _churn_leg(model_dir, single)
     print(
         json.dumps(
@@ -192,6 +271,9 @@ def main():
                 ),
                 **results,
                 "p50_batch256_ms": round(blat[len(blat) // 2] * 1000, 2),
+                "steady_rps": steady_rps,
+                "slo_p95_ms": slo_p95_ms,
+                "slo_violation_rate": slo_violation_rate,
                 "churn_p95_ms": churn_p95_ms,
                 "churn_error_rate": churn_error_rate,
                 "churn_requests": churn_requests,

@@ -253,6 +253,16 @@ def serving_entrypoint(port=None, block=True):
     # lifecycle)
     lifecycle = lifecycle_mod.install(lifecycle_mod.ServingLifecycle())
     app = build_app()
+    # SLO window (armed by instrument_wsgi inside build_app when
+    # SM_SLO_P95_MS is set) quacks like a breaker: a sustained burn over
+    # the error budget shows as DEGRADED in serving_state/serving.state
+    # without flipping /ping — an SLO miss sheds nothing by itself
+    slo_window = telemetry.slo.active_window()
+    if slo_window is not None:
+        lifecycle_mod.observe(slo_window)
+    # kill -3 dumps the flight recorder + status snapshot without killing
+    # the endpoint (the wedged-predict watchdog owns the abort path)
+    telemetry.install_sigquit_handler()
     logger.info(
         "GET /metrics is %s (gate: %s=true)",
         "enabled" if telemetry.metrics_endpoint_enabled() else "disabled",

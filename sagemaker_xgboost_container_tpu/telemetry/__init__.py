@@ -10,11 +10,14 @@ Import surface for the rest of the container:
     from ..telemetry import start_cluster_telemetry  # heartbeats + rank-0 agg
     from ..telemetry import register_runtime_gauges  # XLA/RSS/device gauges
     from ..telemetry import get_request_id      # serving request correlation
+    from ..telemetry import start_fleet_plane   # span shipping + /status
+    from ..telemetry import fleet, slo          # fleet view / serving SLO
 
 See docs/observability.md for the full metric catalogue and env knobs.
 """
 
 from . import tracing  # noqa: F401  (hierarchical tracer: telemetry.tracing)
+from . import fleet  # noqa: F401  (fleet trace/skew/status: telemetry.fleet)
 from .cluster import (  # noqa: F401
     CLUSTER_METRICS_ENV,
     HEARTBEAT_INTERVAL_ENV,
@@ -28,6 +31,14 @@ from .correlation import (  # noqa: F401
     REQUEST_ID_HEADER,
     RequestIdFilter,
     get_request_id,
+)
+from . import slo  # noqa: F401  (serving SLO window: telemetry.slo)
+from .fleet import (  # noqa: F401
+    FLEET_TRACE_ENV,
+    STATUS_PORT_ENV,
+    install_sigquit_handler,
+    start_fleet_plane,
+    stop_fleet_plane,
 )
 from .emit import (  # noqa: F401
     STRUCTURED_METRICS_ENV,

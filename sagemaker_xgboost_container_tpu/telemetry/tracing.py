@@ -76,6 +76,7 @@ class Span:
         "dur_us",
         "tid",
         "thread_name",
+        "seq",
     )
 
     def __init__(self, name, trace_id, parent_id, attributes=None):
@@ -88,6 +89,10 @@ class Span:
         self.dur_us = None  # None while open
         self.tid = threading.get_ident()
         self.thread_name = threading.current_thread().name
+        # recorder-append sequence number, stamped when the span lands in
+        # the flight recorder — the fleet shipper's drain watermark
+        # (telemetry/fleet.py ships spans with seq > last-shipped)
+        self.seq = None
 
     def context(self):
         return (self.trace_id, self.span_id)
@@ -101,6 +106,7 @@ _enabled = None  # cached SM_TRACE verdict; None = not yet resolved
 _rank = 0
 _recorder = None  # deque of finished Span, created lazily
 _live = {}  # span_id -> open Span (for flight-recorder dumps)
+_seq = 0  # monotonic recorder-append counter (survives ring-buffer drops)
 
 
 def enabled():
@@ -147,11 +153,12 @@ def _get_recorder():
 def _reset_for_tests():
     """Drop the cached enable verdict, the ring buffer, live spans, and the
     current thread's span stack (other threads' stacks die with them)."""
-    global _enabled, _recorder, _rank
+    global _enabled, _recorder, _rank, _seq
     with _state_lock:
         _enabled = None
         _recorder = None
         _rank = 0
+        _seq = 0
         _live.clear()
     _tls.stack = []
 
@@ -221,8 +228,11 @@ def finish_span(span, **attributes):
     # "deque mutated during iteration" — on the abort path that would cost
     # the flight-recorder dump at exactly the moment it exists for
     recorder = _get_recorder()  # resolve BEFORE the lock (it may take it)
+    global _seq
     with _state_lock:
         _live.pop(span.span_id, None)
+        _seq += 1
+        span.seq = _seq
         recorder.append(span)
 
 
@@ -252,7 +262,10 @@ def record_span(name, duration_s=0.0, attributes=None, parent=None):
     span.dur_us = max(float(duration_s), 0.0) * 1e6
     span.start_us = max(span.start_us - span.dur_us, 0.0)
     recorder = _get_recorder()
+    global _seq
     with _state_lock:
+        _seq += 1
+        span.seq = _seq
         recorder.append(span)
     return span
 
@@ -291,6 +304,74 @@ def snapshot_spans(include_open=False):
     return spans
 
 
+def span_to_wire(span):
+    """Canonical flat-dict form of a finished span: the fleet shipper's wire
+    payload (telemetry/fleet.py) and the event-builder input — one
+    serialization for the local export and the cross-rank merge."""
+    wire = {
+        "name": span.name,
+        "trace_id": span.trace_id,
+        "span_id": span.span_id,
+        "start_us": round(span.start_us, 3),
+        "dur_us": round(span.dur_us or 0.0, 3),
+        "tid": span.tid,
+        "thread_name": span.thread_name,
+    }
+    if span.parent_id:
+        wire["parent_id"] = span.parent_id
+    if span.attributes:
+        wire["attributes"] = dict(span.attributes)
+    return wire
+
+
+def events_from_wire(wire_spans, pid, process_label):
+    """Chrome-trace events (process/thread metadata + complete "X" events)
+    for one pid lane. ``pid`` is the rank, so per-rank lanes stack in a
+    single Perfetto view — both the per-rank export and the merged
+    ``trace-fleet.json`` build their lanes through this one function."""
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_label},
+        }
+    ]
+    thread_names = {}
+    for wire in wire_spans:
+        thread_names.setdefault(wire.get("tid", 0), wire.get("thread_name", ""))
+    for tid, tname in sorted(thread_names.items(), key=lambda kv: str(kv[0])):
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+        )
+    for wire in wire_spans:
+        args = dict(wire.get("attributes") or {})
+        args["span_id"] = wire.get("span_id")
+        args["trace_id"] = wire.get("trace_id")
+        if wire.get("parent_id"):
+            args["parent_id"] = wire["parent_id"]
+        events.append(
+            {
+                "name": wire.get("name", "?"),
+                "cat": "span",
+                "ph": "X",
+                "pid": pid,
+                "tid": wire.get("tid", 0),
+                "ts": round(float(wire.get("start_us") or 0.0), 3),
+                "dur": round(float(wire.get("dur_us") or 0.0), 3),
+                "args": args,
+            }
+        )
+    return events
+
+
 def chrome_trace_doc(spans=None, extra_metadata=None):
     """-> Chrome-trace JSON object (dict): ``traceEvents`` of complete
     ("X") events in microseconds plus process/thread metadata events. Rank
@@ -298,46 +379,11 @@ def chrome_trace_doc(spans=None, extra_metadata=None):
     if spans is None:
         spans = snapshot_spans()
     rank = get_rank()
-    events = [
-        {
-            "name": "process_name",
-            "ph": "M",
-            "pid": rank,
-            "tid": 0,
-            "args": {"name": "rank {} (os pid {})".format(rank, os.getpid())},
-        }
-    ]
-    thread_names = {}
-    for span in spans:
-        thread_names.setdefault(span.tid, span.thread_name)
-    for tid, tname in sorted(thread_names.items()):
-        events.append(
-            {
-                "name": "thread_name",
-                "ph": "M",
-                "pid": rank,
-                "tid": tid,
-                "args": {"name": tname},
-            }
-        )
-    for span in spans:
-        args = dict(span.attributes)
-        args["span_id"] = span.span_id
-        args["trace_id"] = span.trace_id
-        if span.parent_id:
-            args["parent_id"] = span.parent_id
-        events.append(
-            {
-                "name": span.name,
-                "cat": "span",
-                "ph": "X",
-                "pid": rank,
-                "tid": span.tid,
-                "ts": round(span.start_us, 3),
-                "dur": round(span.dur_us or 0.0, 3),
-                "args": args,
-            }
-        )
+    events = events_from_wire(
+        [span_to_wire(span) for span in spans],
+        pid=rank,
+        process_label="rank {} (os pid {})".format(rank, os.getpid()),
+    )
     metadata = {"rank": rank, "os_pid": os.getpid(), "spans": len(spans)}
     if extra_metadata:
         metadata.update(extra_metadata)

@@ -99,6 +99,13 @@ def instrument_wsgi(app, registry=None):
     """Wrap ``app`` with request metrics and the /metrics route."""
     reg = registry or REGISTRY
 
+    # SLO plane: armed once at wrap time when SM_SLO_P95_MS is set, so BOTH
+    # serving apps get the serving_slo_* series from the first scrape; per
+    # request it costs one is-None test when disarmed
+    from . import slo
+
+    slo_window = slo.maybe_install(reg)
+
     # Hot path: resolve each (route, code) handle once and reuse it — the
     # label space is a closed set, so the cache is bounded and per-request
     # work is a single dict hit instead of registry RLock + key rebuild.
@@ -237,6 +244,8 @@ def instrument_wsgi(app, registry=None):
         status = captured.get("status", "500")
         _counter(route, _code_class(status.split(" ")[0])).inc()
         _latency(route).observe(elapsed)
+        if slo_window is not None and route == "/invocations":
+            slo_window.observe_seconds(elapsed)
         if length:
             _payload(route).observe(length)
         return _TrackedBody(result, _finish) if tracker is not None else result

@@ -317,6 +317,13 @@ def sagemaker_train(
             from ..telemetry import tracing
 
             tracing.set_rank(sorted(participating_hosts).index(current_host))
+            # fleet observability plane last: span shipping needs the rank
+            # set above, and the rank-0 collector/status endpoint bind over
+            # the re-formed cluster like the heartbeat plane (inert unless
+            # SM_FLEET_TRACE / SM_STATUS_PORT are set)
+            from ..telemetry import fleet
+
+            fleet.start_fleet_plane(participating_hosts, current_host)
 
         distributed.distributed_run(
             exec_fun=train_job,
@@ -332,6 +339,11 @@ def sagemaker_train(
                 raise exc.UserError("No data in validation channel path {}".format(val_path))
             logger.info("Single node training.")
             train_args.update({"is_master": True})
+            # single-host jobs still get the /status endpoint (and, with
+            # SM_FLEET_TRACE, a one-lane merged trace over loopback)
+            from ..telemetry import fleet
+
+            fleet.start_fleet_plane([sm_current_host], sm_current_host)
             train_job(**train_args)
         else:
             raise exc.UserError("No data in training channel path {}".format(train_path))
@@ -460,6 +472,12 @@ def maybe_init_jax_distributed(sm_hosts, sm_current_host, port=12355):
         )
         return True
     except Exception as e:
+        # record the failure for the /status endpoint before raising: a
+        # wedged multi-host bring-up is exactly when an operator curls
+        # /status instead of grepping eight hosts' logs
+        from ..telemetry import fleet
+
+        fleet.note_status(backend_init_error=str(e))
         raise exc.PlatformError(
             "Failed to initialize the multi-host XLA runtime", caused_by=e
         )
@@ -511,6 +529,14 @@ def train_job(
     # a data-parallel mesh
     num_round = train_cfg.pop("num_round")
     save_model_on_termination = train_cfg.pop("save_model_on_termination", "false")
+
+    # fleet observability: planned rounds feed the /status ETA, and kill -3
+    # becomes a live inspection dump (flight recorder + skew snapshot)
+    # instead of the default core-dump abort — both no-ops when unobserved
+    from ..telemetry import fleet
+
+    fleet.note_status(rounds_planned=num_round)
+    fleet.install_sigquit_handler(default_dir=model_dir)
 
     tuning_objective_metric_param = train_cfg.pop("_tuning_objective_metric", None)
     eval_metric = train_cfg.get("eval_metric")
@@ -620,6 +646,9 @@ def train_job(
                 from ..telemetry import tracing
 
                 tracing.set_rank(sorted(new_hosts).index(current_host))
+                from ..telemetry import fleet
+
+                fleet.start_fleet_plane(new_hosts, current_host)
 
             bst = elastic.supervised_train(_train_once, on_reform=_on_reform)
         else:
@@ -794,6 +823,14 @@ def train_job(
         tracing.export_traces(default_dir=model_dir)
     except Exception:
         logger.exception("trace export failed; training result unaffected")
+    # fleet merge rides next to the per-rank exports: every rank flushes its
+    # shipper, rank 0 writes trace-fleet.json (inert when the plane is off)
+    from ..telemetry import fleet
+
+    try:
+        fleet.export_fleet_trace(default_dir=model_dir)
+    except Exception:
+        logger.exception("fleet trace export failed; training result unaffected")
 
 
 def _try_parallel_cv(

@@ -322,6 +322,13 @@ class SaveCheckpointCallBack:
             ),
         )
         self.delete_queue.put(epoch - self.max_to_keep)
+        # /status carries the last durably-saved checkpoint: the resume
+        # point an operator would restart from if they killed the job now
+        from ..telemetry import fleet
+
+        fleet.note_status(
+            last_checkpoint={"path": self.format_path(epoch), "round": epoch}
+        )
         if self.num_round is not None and epoch + 1 >= self.num_round:
             self.stop()
         return False

@@ -32,11 +32,17 @@ from ..telemetry import (
     tracing,
 )
 from ..telemetry import percentile  # noqa: F401  (canonical home: telemetry.registry)
+from ..utils.envconfig import env_int
 from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
 
 TRACE_DIR_ENV = "SM_PROFILER_TRACE_DIR"
+
+#: emit a rolling ``training.attribution`` record every N rounds (0 = only
+#: the final one at after_training) — a week-long job surfaces attribution
+#: mid-flight instead of only at the end, and /status reads the same data
+ATTRIBUTION_EVERY_ENV = "SM_ATTRIBUTION_EVERY"
 
 ROUND_HISTOGRAM = "training_round_seconds"
 
@@ -58,6 +64,7 @@ class RoundTimer:
         self.log_every = log_every
         self.emit_structured = emit_structured
         self.fold = fold
+        self._attr_every = env_int(ATTRIBUTION_EVERY_ENV, 0, minimum=0)
         self._last = None
         self._times = []
         self._recorder = None
@@ -146,6 +153,14 @@ class RoundTimer:
                 if self.num_rows and elapsed > 0:
                     fields["rows_per_sec"] = round(self.num_rows / elapsed, 1)
                 emit_metric("training.round", **fields)
+            if (
+                self.emit_structured
+                and self._attr_every
+                and (epoch + 1) % self._attr_every == 0
+            ):
+                self._emit_attribution(
+                    sum(self._times), rolling=True, round_index=epoch
+                )
             if self.log_every and (epoch + 1) % self.log_every == 0:
                 recent = self._times[-self.log_every :]
                 mean = sum(recent) / len(recent)
@@ -200,12 +215,17 @@ class RoundTimer:
                 self._emit_attribution(total)
         return model
 
-    def _emit_attribution(self, total_s):
+    def _emit_attribution(self, total_s, rolling=False, round_index=None):
         """One ``training.attribution`` record: where the run's wall time
         went — XLA compile (the jax.monitoring listener), host dispatch /
         device compute (the SM_TRACE_DEVICE_SYNC sampling spans), and the
         calibrated histogram collectives. Fields are 0.0 when the matching
-        instrumentation wasn't armed, so the record shape is stable."""
+        instrumentation wasn't armed, so the record shape is stable.
+
+        ``rolling=True`` marks the SM_ATTRIBUTION_EVERY mid-job emissions
+        (cumulative since the start of training — same shape, plus the
+        round index) so CloudWatch regexes can tell them from the final
+        after_training record."""
         comm_per_round = get_round_fields().get("hist_comm_ms") or 0.0
         fields = attribution_fields(
             total_ms=total_s * 1000.0,
@@ -216,9 +236,18 @@ class RoundTimer:
             collective_ms=float(comm_per_round) * len(self._times),
         )
         fields["rounds"] = len(self._times)
+        if rolling:
+            fields["rolling"] = True
+        if round_index is not None:
+            fields["round"] = round_index
         if self.fold is not None:
             fields["fold"] = self.fold
         emit_metric("training.attribution", **fields)
+        # publish the same shape to the rank-0 /status endpoint (inert — a
+        # dict update — when the fleet plane never starts)
+        from ..telemetry import fleet
+
+        fleet.note_attribution(fields)
 
 
 def attribution_fields(total_ms, compile_ms, host_ms, device_ms, collective_ms):

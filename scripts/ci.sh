@@ -118,6 +118,19 @@ if [ $rc -eq 0 ] && [ "$TIER" != "chaos" ]; then
   fi
 fi
 
+# fleet-observability smoke (full): 2-rank loopback run validating the
+# merged trace-fleet.json (pid=rank lanes), the per-round skew fold, and
+# the /status endpoint; the merged trace is archived next to the per-rank
+# export (docs/observability.md §Fleet view)
+if [ $rc -eq 0 ] && [ "$TIER" = "full" ]; then
+  if python "$REPO/scripts/fleet_smoke.py" "$ARTIFACT_DIR/traces"; then
+    echo "fleet smoke: OK (artifact: $ARTIFACT_DIR/traces/trace-fleet.json)"
+  else
+    rc=1
+    echo "CI $TIER TIER FAILED (fleet smoke; see $ARTIFACT_DIR/traces)"
+  fi
+fi
+
 # fused-dispatch smoke (full): bounded K=1 vs K=4 micro-run asserting the
 # fused lax.scan round pipeline is bit-identical and not slower; the
 # measured JSON is archived next to the trace/graftlint artifacts

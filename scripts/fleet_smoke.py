@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Fleet-observability smoke: a tiny 2-rank loopback run producing one
+merged ``trace-fleet.json`` (pid=rank lanes) plus a live ``/status`` check.
+
+Sibling of ``trace_smoke.py``: rank 0 is a real traced training run whose
+spans ship over the loopback fleet plane; rank 1 is a synthetic shipper
+feeding fabricated round spans through the same framed-TCP path, so the
+collector exercises the full merge + per-round skew fold without a second
+process. ``scripts/ci.sh`` archives the merged trace under
+``${CI_ARTIFACT_DIR:-.ci-artifacts}/traces/`` next to the per-rank export.
+
+Exit codes: 0 OK, 1 the merged trace / skew fold / status endpoint failed.
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SM_TRACE"] = "1"
+os.environ["SM_FLEET_TRACE"] = "1"
+os.environ["SM_FLEET_FLUSH_S"] = "0.2"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _free_port():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def _fail(msg):
+    sys.stderr.write("fleet smoke FAILED: {}\n".format(msg))
+    return 1
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_dir = argv[0] if argv else os.path.join(".ci-artifacts", "traces")
+    os.environ["SM_TRACE_EXPORT_DIR"] = out_dir
+    os.environ["SM_FLEET_TRACE_PORT"] = str(_free_port())
+    os.environ["SM_STATUS_PORT"] = str(_free_port())
+
+    import urllib.request
+
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.telemetry import fleet, tracing
+    from sagemaker_xgboost_container_tpu.training.profiling import RoundTimer
+
+    hosts = ["algo-1", "algo-2"]
+    tracing.set_rank(0)
+    plane = fleet.start_fleet_plane(hosts, "algo-1")
+    if plane is None or plane.collector is None:
+        return _fail("fleet plane did not start a rank-0 collector")
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.rand(256, 4).astype(np.float32)
+        y = (X[:, 0] > 0.5).astype(np.float32)
+        rounds = 3
+        train(
+            {"objective": "binary:logistic", "max_depth": 3},
+            DataMatrix(X, labels=y),
+            num_boost_round=rounds,
+            callbacks=[RoundTimer(num_rows=256, log_every=0, emit_structured=False)],
+        )
+
+        # rank 1: fabricated fast-lane spans for the same round ids, shipped
+        # through the real framed-TCP path so the collector folds a full
+        # 2-rank skew report per round
+        def rank1_spans():
+            wire = []
+            for r in range(rounds):
+                base = float(r) * 10_000.0
+                wire.append(
+                    {
+                        "name": "host_dispatch",
+                        "trace_id": "smoke-r1-{}".format(r),
+                        "span_id": "smoke-r1-h{}".format(r),
+                        "start_us": base + 10.0,
+                        "dur_us": 200.0,
+                        "tid": 1,
+                        "thread_name": "MainThread",
+                    }
+                )
+                wire.append(
+                    {
+                        "name": "round",
+                        "trace_id": "smoke-r1-{}".format(r),
+                        "span_id": "smoke-r1-{}".format(r),
+                        "start_us": base,
+                        "dur_us": 500.0,
+                        "tid": 1,
+                        "thread_name": "MainThread",
+                        "attributes": {"round": r},
+                    }
+                )
+            return wire
+
+        shipper = fleet.SpanShipper(
+            rank=1,
+            host="algo-2",
+            collector_addr=("127.0.0.1", plane.collector.port),
+            interval=0.2,
+            span_source=rank1_spans,
+        )
+        if not shipper.send_once():
+            return _fail("rank-1 synthetic span batch did not deliver")
+
+        # /status while the plane is live
+        status_port = int(os.environ["SM_STATUS_PORT"])
+        with urllib.request.urlopen(
+            "http://127.0.0.1:{}/status".format(status_port), timeout=5
+        ) as resp:
+            status = json.loads(resp.read().decode("utf-8"))
+        if "round" not in status or "uptime_s" not in status:
+            return _fail("/status payload missing round/uptime_s: {}".format(status))
+
+        path = fleet.export_fleet_trace(default_dir=out_dir)
+        if not path or not os.path.isfile(path):
+            return _fail("no merged trace-fleet.json produced")
+        with open(path) as f:
+            doc = json.load(f)
+        spans = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+        lanes = {e["pid"] for e in spans}
+        if lanes != {0, 1}:
+            return _fail("expected pid lanes {{0, 1}}, got {}".format(sorted(lanes)))
+        round_ids = {}
+        for e in spans:
+            if e["name"] == "round" and "round" in e.get("args", {}):
+                round_ids.setdefault(e["pid"], set()).add(e["args"]["round"])
+        shared = round_ids.get(0, set()) & round_ids.get(1, set())
+        if len(shared) < rounds:
+            return _fail(
+                "rank lanes do not share round ids: {}".format(round_ids)
+            )
+
+        # the skew fold saw both ranks for every round
+        deadline = time.time() + 5.0
+        reports = plane.collector.skew_snapshot()
+        while len(reports) < rounds and time.time() < deadline:
+            time.sleep(0.05)
+            reports = plane.collector.skew_snapshot()
+        if len(reports) < rounds:
+            return _fail("expected {} skew reports, got {}".format(rounds, reports))
+    finally:
+        fleet.stop_fleet_plane()
+
+    print(
+        "fleet smoke OK: {} ({} spans, lanes {}, {} skew reports)".format(
+            path, len(spans), sorted(lanes), len(reports)
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Serving SLO plane (telemetry/slo.py).
+
+Covers the burn-rate math against an injected clock (violating fraction /
+error budget, rolling-window trim), the MIN_SAMPLES guard on the
+breaker-shaped ``degraded`` property, the SM_SLO_P95_MS install gating
+(unset = no window, no series), the WSGI /invocations feed on an
+instrumented app, the serving_slo_* series in the exposition text, and the
+lifecycle integration (a sustained burn flips the derived DEGRADED state).
+"""
+
+import json
+
+import pytest
+
+from sagemaker_xgboost_container_tpu.serving import lifecycle
+from sagemaker_xgboost_container_tpu.telemetry import slo
+from sagemaker_xgboost_container_tpu.telemetry.prometheus import render_text
+from sagemaker_xgboost_container_tpu.telemetry.registry import MetricsRegistry
+from sagemaker_xgboost_container_tpu.telemetry.wsgi import instrument_wsgi
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def slo_env(monkeypatch):
+    monkeypatch.delenv(slo.SLO_P95_ENV, raising=False)
+    monkeypatch.delenv(slo.SLO_WINDOW_ENV, raising=False)
+    slo._reset_for_tests()
+    yield monkeypatch
+    slo._reset_for_tests()
+
+
+# --------------------------------------------------------------- the math
+class TestBurnRate:
+    def test_violating_fraction_over_budget(self, slo_env):
+        clock = FakeClock()
+        window = SloWindowFresh(target=100.0, clock=clock)
+        # 18 good + 2 violating out of 20 -> 10% violating, 2x the 5% budget
+        for _ in range(18):
+            window.observe_ms(50.0)
+        for _ in range(2):
+            window.observe_ms(250.0)
+        snap = window.snapshot()
+        assert snap["samples"] == 20
+        assert snap["violation_rate"] == pytest.approx(0.1)
+        assert snap["burn_rate"] == pytest.approx(2.0)
+        assert snap["p50_ms"] == pytest.approx(50.0)
+        assert window._m_violations.value == 2
+
+    def test_window_trims_old_samples(self, slo_env):
+        clock = FakeClock()
+        window = SloWindowFresh(target=100.0, window_s=60.0, clock=clock)
+        for _ in range(30):
+            window.observe_ms(500.0)  # all violating
+        assert window.degraded is True
+        clock.advance(61.0)  # everything ages out
+        snap = window.snapshot()
+        assert snap["samples"] == 0
+        assert snap["burn_rate"] == 0.0
+        assert window.degraded is False
+
+    def test_min_samples_guard(self, slo_env):
+        clock = FakeClock()
+        window = SloWindowFresh(target=100.0, clock=clock)
+        for _ in range(slo.MIN_SAMPLES - 1):
+            window.observe_ms(500.0)
+        # burn is 20x but the sample floor holds the breaker open
+        assert window.snapshot()["burn_rate"] > 1.0
+        assert window.degraded is False
+        window.observe_ms(500.0)
+        assert window.degraded is True
+
+
+def SloWindowFresh(target, window_s=None, clock=None):
+    return slo.SloWindow(
+        target, window_s=window_s, registry=MetricsRegistry(), clock=clock
+    )
+
+
+# ----------------------------------------------------------------- install
+class TestInstallGating:
+    def test_unset_means_no_window_no_series(self, slo_env):
+        reg = MetricsRegistry()
+        assert slo.maybe_install(reg) is None
+        assert slo.active_window() is None
+        assert "serving_slo" not in render_text(reg)
+
+    def test_armed_and_idempotent(self, slo_env):
+        slo_env.setenv(slo.SLO_P95_ENV, "75")
+        slo_env.setenv(slo.SLO_WINDOW_ENV, "120")
+        reg = MetricsRegistry()
+        window = slo.maybe_install(reg)
+        assert window is not None
+        assert window.target_p95_ms == 75.0
+        assert window.window_s == 120.0
+        assert slo.maybe_install(reg) is window
+        # the series exist from arm time, before any request
+        text = render_text(reg)
+        assert "serving_slo_violation_total 0" in text
+        assert "\nserving_slo_burn_rate " in text
+
+
+# --------------------------------------------------------------- wsgi feed
+class TestWsgiFeed:
+    def _call(self, app, path):
+        captured = {}
+
+        def start_response(status, headers, exc_info=None):
+            captured["status"] = status
+
+        environ = {
+            "PATH_INFO": path,
+            "REQUEST_METHOD": "POST",
+            "CONTENT_LENGTH": "3",
+        }
+        body = b"".join(app(environ, start_response))
+        return captured["status"], body
+
+    def test_invocations_feed_and_exposition(self, slo_env):
+        slo_env.setenv(slo.SLO_P95_ENV, "1000")
+        reg = MetricsRegistry()
+
+        def inner(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"ok"]
+
+        app = instrument_wsgi(inner, registry=reg)
+        window = slo.active_window()
+        assert window is not None
+        status, _ = self._call(app, "/invocations")
+        assert status.startswith("200")
+        assert window.snapshot()["samples"] == 1
+        # non-invocations routes never feed the window
+        self._call(app, "/ping")
+        assert window.snapshot()["samples"] == 1
+        assert "serving_slo_burn_rate" in render_text(reg)
+
+
+# ------------------------------------------------------- lifecycle breaker
+class TestLifecycleIntegration:
+    def test_sustained_burn_degrades_state(self, slo_env, capfd):
+        clock = FakeClock()
+        window = SloWindowFresh(target=10.0, clock=clock)
+        lc = lifecycle.install(lifecycle.ServingLifecycle())
+        try:
+            lc.mark_ready()
+            lifecycle.observe(window)
+            assert lc.state == lifecycle.READY
+            for _ in range(slo.MIN_SAMPLES + 5):
+                window.observe_ms(100.0)  # every request violates
+            lifecycle.observe(window)
+            assert lc.state == lifecycle.DEGRADED
+            clock.advance(window.window_s + 1)
+            lifecycle.observe(window)
+            assert lc.state == lifecycle.READY
+        finally:
+            lifecycle.uninstall()

@@ -59,6 +59,9 @@ _CONFIG_KEYS = (
 # line carries the captured reason instead of silently reading "CPU" —
 # BASELINE.md: every TPU probe so far wedged at init with no recorded cause
 _backend_init_error = None
+# the pre-check's SUCCESS-path facts (platform, device count, per-device
+# memory_stats) — a healthy TPU run should be as diagnosable as a wedged one
+_backend_probe_info = None
 
 
 def _emit(doc):
@@ -66,6 +69,8 @@ def _emit(doc):
     last parseable line wins, so best-so-far lines are safe to emit)."""
     if _backend_init_error and "backend_init_error" not in doc:
         doc = dict(doc, backend_init_error=_backend_init_error)
+    if _backend_probe_info and "backend_info" not in doc:
+        doc = dict(doc, backend_info=_backend_probe_info)
     print(json.dumps(doc), flush=True)
 
 
@@ -114,9 +119,22 @@ def _backend_healthy(timeout):
     BENCH JSON as ``backend_init_error`` so a wedged init finally leaves a
     reason behind instead of a silent CPU fallback."""
     code = (
-        "import jax, jax.numpy as j;"
-        "print('DEVICES', len(jax.devices()));"
-        "print(float((j.ones((128,128))@j.ones((128,128))).sum()))"
+        "import jax, jax.numpy as j, json\n"
+        "ds = jax.devices()\n"
+        "print('DEVICES', len(ds))\n"
+        "print(float((j.ones((128,128))@j.ones((128,128))).sum()))\n"
+        "info = {'platform': ds[0].platform,"
+        " 'device_kind': getattr(ds[0], 'device_kind', 'unknown'),"
+        " 'n_devices': len(ds), 'memory_stats': []}\n"
+        "for d in ds:\n"
+        "    try:\n"
+        "        s = d.memory_stats() or {}\n"
+        "    except Exception:\n"
+        "        s = {}\n"
+        "    info['memory_stats'].append({'id': d.id,"
+        " 'bytes_in_use': int(s.get('bytes_in_use', 0)),"
+        " 'bytes_limit': int(s.get('bytes_limit', 0))})\n"
+        "print('BACKEND_INFO', json.dumps(info))\n"
     )
     t0 = time.monotonic()
     try:
@@ -132,9 +150,18 @@ def _backend_healthy(timeout):
             "elapsed_s": round(time.monotonic() - t0, 1),
         }
     n_devices = 1
+    global _backend_probe_info
     for line in r.stdout.splitlines():
         if line.startswith("DEVICES "):
             n_devices = int(line.split()[1])
+        elif line.startswith("BACKEND_INFO ") and r.returncode == 0:
+            try:
+                _backend_probe_info = json.loads(line.split(" ", 1)[1])
+                _backend_probe_info["probe_s"] = round(
+                    time.monotonic() - t0, 1
+                )
+            except (ValueError, IndexError):
+                pass
     if r.returncode == 0:
         return True, n_devices, None
     tail = " | ".join(r.stderr.strip().splitlines()[-3:])[-400:]
@@ -723,6 +750,9 @@ def main():
     # every dispatch so host_dispatch/device_sync phases are measured (the
     # bench loop blocks per dispatch anyway, so the fence costs nothing)
     os.environ.setdefault("SM_TRACE_DEVICE_SYNC", "1")
+    # arm the device window too: the session's compiled-cost introspection
+    # (training.compiled) plus the roofline stamp below ride the same gate
+    os.environ.setdefault("SM_DEVICE_TELEMETRY", "1")
     from sagemaker_xgboost_container_tpu.telemetry import register_runtime_gauges
     from sagemaker_xgboost_container_tpu.telemetry.cluster import compile_stats
 
@@ -902,6 +932,18 @@ def main():
         "phases_ms": phases_ms,
         "attribution": attribution,
     }
+    # roofline stamp for the measured window: achieved FLOPs/s and bytes/s
+    # against the compiled cost captured at session build (device window)
+    from sagemaker_xgboost_container_tpu.telemetry import device as device_telemetry
+
+    device_ms = _delta("device_sync") * 1000
+    source = "device_sync"
+    if device_ms <= 0.0:
+        device_ms = max(elapsed * 1000.0 - compile_ms - host_ms, 0.0)
+        source = "residual"
+    roofline = device_telemetry.maybe_roofline(device_ms, done, source)
+    if roofline is not None:
+        doc["roofline"] = roofline
     if backend_err is not None:
         doc["backend_init_error"] = backend_err
     print(json.dumps(doc))

@@ -182,6 +182,34 @@ def _churn_leg(model_dir, single_payload):
     return round(p95, 2), round(errors / total, 4) if total else 1.0, total
 
 
+def _predict_compiled_cost(forest, num_feature, rows=256):
+    """Compiled cost of the device predict kernel for one padded row bucket
+    (the batch-256 leg's bucket): flops / bytes / HBM footprint via the same
+    AOT introspection the training device window uses. Returns None when the
+    forest is empty or introspection is unavailable."""
+    import jax.numpy as jnp
+
+    from sagemaker_xgboost_container_tpu.models.forest import predict_bucket
+    from sagemaker_xgboost_container_tpu.ops.predict import (
+        _forest_margin,
+        _stacked_args,
+    )
+    from sagemaker_xgboost_container_tpu.telemetry import device as device_telemetry
+
+    stacked = forest._stack(slice(0, len(forest.trees)))
+    if stacked is None:
+        return None
+    bucket = predict_bucket(rows)
+    x = jnp.zeros((bucket, num_feature), jnp.float32)
+    lowered = _forest_margin.lower(
+        *_stacked_args(stacked, "leaf_value"), x, stacked["depth"]
+    )
+    cost = device_telemetry.cost_from_compiled(lowered.compile())
+    cost["rows"] = bucket
+    cost["trees"] = len(forest.trees)
+    return cost
+
+
 def main():
     import urllib.request
     from wsgiref.simple_server import make_server
@@ -263,6 +291,12 @@ def main():
     # view (ROADMAP item 3), then the churn leg's rolling restarts
     steady_rps, slo_p95_ms, slo_violation_rate = _steady_leg(model_dir, single)
     churn_p95_ms, churn_error_rate, churn_requests = _churn_leg(model_dir, single)
+    try:
+        predict_compiled = _predict_compiled_cost(forest, X.shape[1])
+    except Exception as e:  # introspection must never sink the benchmark
+        sys.stderr.write("predict kernel cost introspection failed: {}\n".format(e))
+        predict_compiled = None
+    extra = {"predict_compiled": predict_compiled} if predict_compiled else {}
     print(
         json.dumps(
             {
@@ -279,6 +313,7 @@ def main():
                 "churn_requests": churn_requests,
                 "churn_cycles": CHURN_CYCLES,
                 "unit": "ms",
+                **extra,
             }
         )
     )

@@ -153,3 +153,4 @@ EXIT_REFORM_FAILED = 82   # elastic shrink: survivor re-rendezvous failed; resta
 EXIT_DRAIN_TIMEOUT = 83   # serving drain: in-flight requests still wedged past SM_DRAIN_TIMEOUT_S
 EXIT_PREDICT_STUCK = 84   # serving watchdog: a predict dispatch wedged past SM_PREDICT_STUCK_S (abort action)
 EXIT_INGEST_FAILED = 85   # streaming ingest: bad-chunk budget exhausted or a cross-rank consistency failure
+EXIT_DEVICE_OOM = 86      # device allocator exhausted (RESOURCE_EXHAUSTED) during a round dispatch; HBM forensics dumped

@@ -828,6 +828,7 @@ class _TrainingSession:
 
         self._round_fn = self._make_round_fn()
         self._apply_fn = self._make_apply_fn()
+        self._introspect_compiled_cost()
 
     # ------------------------------------------------------------------ jit
     def _grad_hess_fn(self):
@@ -1405,6 +1406,84 @@ class _TrainingSession:
                 _pad_rows(eb, self._eval_pads[i], max_bin), P("data", None)
             )
 
+    # ------------------------------------------------------- device window
+    def _introspect_compiled_cost(self):
+        """AOT-lower the fused round dispatch and feed its XLA
+        ``cost_analysis``/``memory_analysis`` into the device-window plane
+        (``training.compiled`` record + flops/HBM gauges). Gated on
+        ``SM_DEVICE_TELEMETRY`` because the AOT compile is real work (the
+        jit path's own compile is served from the persistent cache when
+        ``GRAFT_COMPILE_CACHE_DIR`` is armed); lowering never *executes*,
+        so donated buffers are not consumed. Diagnostics only — any
+        failure is one warning, never a failed session."""
+        from ..telemetry import device as device_telemetry
+
+        if not device_telemetry.enabled():
+            return
+        try:
+            d_pad = self.bins.shape[1]
+            mask_np = np.ones(d_pad, np.float32)
+            if self.has_feature_axis:
+                feature_mask = self._put(mask_np, self.feat_spec)
+            else:
+                feature_mask = jnp.asarray(mask_np)
+            # self.rng is key-shaped and is NOT consumed here — lowering
+            # only reads avals, so the training stream stays bit-identical
+            args = (
+                self.bins,
+                self.margins,
+                self.labels,
+                self.weights,
+                self.num_cuts,
+                self.rng,
+                feature_mask,
+                self.monotone,
+                self.rank_index_dev,
+            )
+            if self.use_scan_rounds:
+                eval_m = tuple(m for m in self.eval_margins if m is not None)
+                eval_blw = tuple(
+                    (self.eval_bins[i], self.eval_labels[i], self.eval_weights[i])
+                    for i in range(len(self.eval_bins))
+                    if self.eval_bins[i] is not None
+                )
+                lowered = self._round_fn.lower(*args, eval_m, eval_blw)
+            else:
+                lowered = self._round_fn.lower(*args)
+            cost = device_telemetry.cost_from_compiled(lowered.compile())
+            mesh_shape = dict(self.mesh.shape) if self.mesh is not None else None
+            device_telemetry.note_compiled(
+                cost,
+                mesh_shape=mesh_shape,
+                rounds_per_dispatch=self.rounds_per_dispatch,
+                backend=jax.default_backend(),
+            )
+        except Exception as e:
+            logger.warning(
+                "compiled-cost introspection failed (%s); training continues "
+                "without the training.compiled record",
+                e,
+            )
+
+    def _abort_device_oom(self, exc):
+        """A round dispatch died with the allocator exhausted: dump the HBM
+        forensics (top live buffers, allocator stats, compiled memory
+        analysis, last watermark), then take the shared watchdog abort path
+        (checkpoint flush + flight recorder + ``training.abort``) with
+        exit 86 so the platform log names the OOM instead of a raw XLA
+        traceback."""
+        from ..constants import EXIT_DEVICE_OOM
+        from ..telemetry import device as device_telemetry
+        from ..training import watchdog
+
+        path = device_telemetry.dump_oom_forensics(exc)
+        watchdog.request_abort(
+            "device_oom",
+            EXIT_DEVICE_OOM,
+            error=str(exc)[:400],
+            forensics=path or "",
+        )
+
     # ---------------------------------------------------------------- round
     def _maybe_fenced_dispatch(self, dispatch):
         """Run one round dispatch, attribution-fenced on every Nth call
@@ -1445,7 +1524,24 @@ class _TrainingSession:
         """One device dispatch -> (list of host tree dicts, metrics or None).
 
         metrics: [K, n_metrics] numpy when device metrics are active (batched
-        mode); None when evaluation happens host-side (K=1)."""
+        mode); None when evaluation happens host-side (K=1).
+
+        An allocator exhaustion anywhere in the dispatch (the async XLA
+        error materializes at the blocking transfer) is terminal for the
+        process — no retry can succeed against a full HBM — so it routes
+        through the OOM forensics dump + watchdog abort (exit 86) instead
+        of unwinding as a raw traceback. Every other exception propagates
+        unchanged."""
+        try:
+            return self._run_rounds_inner()
+        except Exception as e:
+            from ..telemetry import device as device_telemetry
+
+            if device_telemetry.is_oom_error(e):
+                self._abort_device_oom(e)
+            raise
+
+    def _run_rounds_inner(self):
         if self.approx_resketch:
             self._resketch_bins()
         self.rng, sub, colrng = jax.random.split(self.rng, 3)

@@ -17,6 +17,7 @@ See docs/observability.md for the full metric catalogue and env knobs.
 """
 
 from . import tracing  # noqa: F401  (hierarchical tracer: telemetry.tracing)
+from . import device  # noqa: F401  (device window: telemetry.device)
 from . import fleet  # noqa: F401  (fleet trace/skew/status: telemetry.fleet)
 from .cluster import (  # noqa: F401
     CLUSTER_METRICS_ENV,

@@ -228,23 +228,14 @@ def _open_fds():
 
 def _device_live_bytes():
     """Live device buffer bytes: per-device allocator stats when the backend
-    exposes them (TPU), else the sum of live jax array footprints."""
+    exposes them (TPU), else the sum of live jax array footprints. The walk
+    itself lives in ``telemetry/device.py`` behind a short-lived cache so
+    the heartbeat sender, the per-round HBM watermark, and ``/status`` pay
+    at most one O(live-buffers) sweep per interval between them."""
     try:
-        import jax
+        from . import device
 
-        total = 0
-        seen_stats = False
-        for dev in jax.devices():
-            try:
-                stats = dev.memory_stats()
-            except Exception:
-                stats = None
-            if stats and "bytes_in_use" in stats:
-                total += int(stats["bytes_in_use"])
-                seen_stats = True
-        if seen_stats:
-            return total
-        return int(sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+        return int(device.sample_device_memory()["total_bytes_in_use"])
     except Exception:
         return 0
 

@@ -1,0 +1,491 @@
+"""Device-window attribution plane (``SM_DEVICE_TELEMETRY``): what the fused
+round program *costs*, what HBM is actually resident, and why a dispatch
+OOMs.
+
+PR 7/10/13 split every round into compile/host/device/collective/wire, but
+the ``device`` bucket itself stayed a black box. This module opens it with
+four connected pieces, all env-gated like the fleet plane (zero threads,
+zero records, zero registry series when ``SM_DEVICE_TELEMETRY`` is unset):
+
+* **Compiled-cost introspection** — at session build the booster AOT-lowers
+  the fused round dispatch and feeds ``cost_analysis()`` /
+  ``memory_analysis()`` through :func:`cost_from_compiled` into
+  :func:`note_compiled`: one ``training.compiled`` record (flops, bytes
+  accessed, peak arg/output/temp HBM bytes, per mesh shape and
+  ``rounds_per_dispatch``) plus the ``device_flops_per_round`` /
+  ``device_hbm_peak_bytes`` gauges.
+* **Per-round HBM watermark** — RoundTimer samples
+  :func:`sample_device_memory` every ``SM_HBM_SAMPLE_EVERY`` rounds
+  (:func:`sample_watermark`). The sampler is the ONE cached
+  O(live-buffers) walk shared with the heartbeat plane
+  (``telemetry/cluster.py`` delegates here), so heartbeats and round
+  sampling never pay it twice per interval. Watermarks ride the fleet
+  span shipper to rank 0, where ``/status`` renders a memory section and
+  names a *memory*-skewed rank.
+* **Roofline attribution** — :func:`roofline_fields` combines measured
+  device time with the compiled cost into achieved FLOPs/s, bytes/s, and
+  the binding resource (compute / memory / latency); RoundTimer emits one
+  ``training.roofline`` record and mirrors it into
+  ``training.attribution``, ``/status``, and bench.py's final JSON.
+* **OOM forensics** — :func:`dump_oom_forensics` writes
+  ``hbm-forensics-rank<r>.json`` (top live buffers by shape/size,
+  allocator stats, the compiled memory analysis, the last watermark) on
+  the booster's ``RESOURCE_EXHAUSTED`` path before the watchdog abort
+  (exit 86, ``EXIT_DEVICE_OOM``). The forensics path is robustness, not
+  telemetry: like exits 79-85 it fires regardless of the gate.
+
+Binding-resource heuristic (deterministic, no hardware database): a round
+whose device time sits under ``LATENCY_FLOOR_MS`` is dispatch-floor bound
+("latency"); otherwise operational intensity (flops / bytes accessed)
+against ``DEFAULT_RIDGE_FLOPS_PER_BYTE`` splits "compute" from "memory".
+The ridge is a documented constant carried in every record, so a reader
+can re-judge against their hardware's real ridge point.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+from ..utils.envconfig import env_bool, env_int
+from .emit import emit_metric
+from .registry import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+#: master gate: unset ⇒ no records, no gauges, no sampling cadence
+DEVICE_TELEMETRY_ENV = "SM_DEVICE_TELEMETRY"
+#: watermark cadence in rounds (>= 1); read once per training session
+HBM_SAMPLE_EVERY_ENV = "SM_HBM_SAMPLE_EVERY"
+DEFAULT_HBM_SAMPLE_EVERY = 8
+
+#: operational-intensity ridge (flops per HBM byte) splitting compute- from
+#: memory-bound; stamped into every roofline record so the verdict can be
+#: re-judged against real hardware (v5p HBM ridge is far higher — a program
+#: memory-bound at 10 is memory-bound everywhere that matters)
+DEFAULT_RIDGE_FLOPS_PER_BYTE = 10.0
+#: per-round device time under this is dominated by the per-dispatch floor
+#: (host->device transfer, dispatch latency), not by the program itself
+LATENCY_FLOOR_MS = 0.5
+
+#: one cached device-memory walk serves every consumer inside this window
+SAMPLE_MAX_AGE_S = 1.0
+
+_state_lock = threading.Lock()
+_last_compiled = None  # the note_compiled record (train round program)
+_last_watermark = None  # the last sample_watermark result
+_watermark_high = 0  # high-water bytes_in_use across watermark samples
+
+_sample_lock = threading.Lock()
+_sample_cache = None  # (monotonic stamp, snapshot dict)
+
+
+def enabled():
+    return env_bool(DEVICE_TELEMETRY_ENV, False)
+
+
+def hbm_sample_every():
+    return env_int(HBM_SAMPLE_EVERY_ENV, DEFAULT_HBM_SAMPLE_EVERY, minimum=1)
+
+
+def sample_cadence():
+    """Watermark cadence for RoundTimer: 0 (never sample) when the plane is
+    unarmed, else ``SM_HBM_SAMPLE_EVERY``. Resolved once per session by the
+    caller — the per-round path never reads env."""
+    return hbm_sample_every() if enabled() else 0
+
+
+# ------------------------------------------------------- cached memory walk
+def _sample_uncached():
+    """One O(devices) + O(live-buffers) walk: per-device allocator stats
+    where the backend reports them (TPU), else the summed footprint of live
+    jax arrays — the same ladder the heartbeat plane used before it was
+    hoisted here. Never raises."""
+    snap = {
+        "total_bytes_in_use": 0,
+        "peak_bytes_in_use": 0,
+        "bytes_limit": 0,
+        "source": "none",
+        "devices": [],
+    }
+    try:
+        import jax
+
+        seen_stats = False
+        for dev in jax.devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats or "bytes_in_use" not in stats:
+                continue
+            seen_stats = True
+            entry = {
+                "id": getattr(dev, "id", len(snap["devices"])),
+                "kind": getattr(dev, "device_kind", "unknown"),
+                "bytes_in_use": int(stats["bytes_in_use"]),
+                "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit", 0)),
+            }
+            snap["devices"].append(entry)
+            snap["total_bytes_in_use"] += entry["bytes_in_use"]
+            snap["peak_bytes_in_use"] += entry["peak_bytes_in_use"]
+            snap["bytes_limit"] += entry["bytes_limit"]
+        if seen_stats:
+            snap["source"] = "memory_stats"
+            return snap
+        snap["total_bytes_in_use"] = int(
+            sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+        )
+        snap["source"] = "live_arrays"
+    except Exception:
+        pass
+    return snap
+
+
+def sample_device_memory(max_age_s=SAMPLE_MAX_AGE_S):
+    """The shared device-memory snapshot, cached for ``max_age_s`` seconds
+    so the heartbeat sender, the round watermark, and ``/status`` together
+    pay at most one live-buffer walk per interval. ``max_age_s=0`` forces a
+    fresh walk (OOM forensics). Passive and ungated: creates no threads and
+    emits nothing, so unarmed callers (the heartbeat plane) stay inert."""
+    global _sample_cache
+    now = time.monotonic()
+    with _sample_lock:
+        cached = _sample_cache
+        if cached is not None and now - cached[0] <= max_age_s:
+            return cached[1]
+    snap = _sample_uncached()
+    with _sample_lock:
+        _sample_cache = (time.monotonic(), snap)
+    return snap
+
+
+# --------------------------------------------------------- compiled program
+def cost_from_compiled(compiled):
+    """Extract the cost/memory analyses of a jax AOT ``Compiled`` into one
+    flat dict of floats/ints (absent analyses yield zeros — some backends
+    return nothing for trivial programs). ``cost_analysis()`` is a dict on
+    recent jax and a one-element list of dicts on older releases; both
+    shapes are handled."""
+    cost = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0}
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        if isinstance(analysis, dict):
+            cost["flops"] = float(analysis.get("flops", 0.0) or 0.0)
+            cost["bytes_accessed"] = float(
+                analysis.get("bytes accessed", 0.0) or 0.0
+            )
+            cost["transcendentals"] = float(
+                analysis.get("transcendentals", 0.0) or 0.0
+            )
+    except Exception as e:
+        logger.debug("cost_analysis unavailable: %s", e)
+    mem = {"arg_bytes": 0, "out_bytes": 0, "temp_bytes": 0, "alias_bytes": 0}
+    try:
+        analysis = compiled.memory_analysis()
+        mem["arg_bytes"] = int(
+            getattr(analysis, "argument_size_in_bytes", 0) or 0
+        )
+        mem["out_bytes"] = int(getattr(analysis, "output_size_in_bytes", 0) or 0)
+        mem["temp_bytes"] = int(getattr(analysis, "temp_size_in_bytes", 0) or 0)
+        mem["alias_bytes"] = int(getattr(analysis, "alias_size_in_bytes", 0) or 0)
+    except Exception as e:
+        logger.debug("memory_analysis unavailable: %s", e)
+    cost.update(mem)
+    return cost
+
+
+def note_compiled(
+    cost,
+    mesh_shape=None,
+    rounds_per_dispatch=1,
+    backend=None,
+    kind="train_round",
+    registry=None,
+):
+    """Fold one program's cost dict (:func:`cost_from_compiled`) into the
+    plane: emit the ``training.compiled`` record, set the gauges, and keep
+    the record for roofline math, ``/status``, and OOM forensics. The
+    caller gates on :func:`enabled` — this function assumes the plane is
+    armed. Returns the record."""
+    k = max(int(rounds_per_dispatch or 1), 1)
+    record = dict(cost)
+    record["kind"] = kind
+    record["rounds_per_dispatch"] = k
+    record["flops_per_round"] = round(record.get("flops", 0.0) / k, 1)
+    record["bytes_per_round"] = round(record.get("bytes_accessed", 0.0) / k, 1)
+    # peak resident HBM of one dispatch: everything the executable holds at
+    # once — donated/aliased args overlap outputs, so subtract the alias
+    peak = (
+        record.get("arg_bytes", 0)
+        + record.get("out_bytes", 0)
+        + record.get("temp_bytes", 0)
+        - record.get("alias_bytes", 0)
+    )
+    record["hbm_peak_bytes"] = int(max(peak, 0))
+    if mesh_shape:
+        record["mesh_shape"] = {str(a): int(n) for a, n in dict(mesh_shape).items()}
+    if backend:
+        record["backend"] = backend
+    global _last_compiled
+    with _state_lock:
+        if kind == "train_round" or _last_compiled is None:
+            _last_compiled = record
+    reg = registry or REGISTRY
+    reg.gauge(
+        "device_flops_per_round",
+        "Compiled FLOPs of one boosting round (XLA cost_analysis / K)",
+    ).set(record["flops_per_round"])
+    reg.gauge(
+        "device_hbm_peak_bytes",
+        "Peak resident HBM bytes of one round dispatch (arg+out+temp-alias)",
+    ).set(record["hbm_peak_bytes"])
+    emit_metric("training.compiled", **record)
+    from . import fleet
+
+    fleet.note_status(compiled=record)
+    return record
+
+
+def last_compiled():
+    with _state_lock:
+        return dict(_last_compiled) if _last_compiled is not None else None
+
+
+# ---------------------------------------------------------------- watermark
+def sample_watermark(round_index, registry=None):
+    """One per-round HBM watermark sample (RoundTimer, on the
+    ``SM_HBM_SAMPLE_EVERY`` cadence — the caller owns the cadence check).
+    Updates the ``hbm_watermark_bytes`` gauge and the wire-side state the
+    fleet shipper sends to rank 0. Returns the watermark dict."""
+    snap = sample_device_memory()
+    watermark = {
+        "round": int(round_index),
+        "bytes_in_use": int(snap["total_bytes_in_use"]),
+        "peak_bytes": int(snap["peak_bytes_in_use"]),
+        "source": snap["source"],
+    }
+    global _last_watermark, _watermark_high
+    with _state_lock:
+        _last_watermark = watermark
+        _watermark_high = max(_watermark_high, watermark["bytes_in_use"])
+    (registry or REGISTRY).gauge(
+        "hbm_watermark_bytes",
+        "Live HBM bytes at the last per-round watermark sample",
+    ).set(watermark["bytes_in_use"])
+    return watermark
+
+
+def watermark_wire():
+    """The latest watermark for the fleet span shipper (None when the plane
+    is unarmed or no round has been sampled yet — an absent key costs the
+    frame nothing)."""
+    if not enabled():
+        return None
+    with _state_lock:
+        if _last_watermark is None:
+            return None
+        wire = dict(_last_watermark)
+        wire["high_bytes"] = _watermark_high
+        return wire
+
+
+def memory_status():
+    """The local memory section for ``/status`` and the SIGQUIT dump: a
+    fresh (cached) sample plus the watermark history and the compiled
+    program's predicted peak. None when the plane is unarmed."""
+    if not enabled():
+        return None
+    doc = {"current": sample_device_memory()}
+    with _state_lock:
+        if _last_watermark is not None:
+            doc["watermark"] = dict(_last_watermark)
+            doc["high_bytes"] = _watermark_high
+        if _last_compiled is not None:
+            doc["compiled_hbm_peak_bytes"] = _last_compiled.get(
+                "hbm_peak_bytes", 0
+            )
+    return doc
+
+
+# ----------------------------------------------------------------- roofline
+def roofline_fields(
+    compiled,
+    device_ms,
+    rounds,
+    source="residual",
+    ridge=DEFAULT_RIDGE_FLOPS_PER_BYTE,
+    latency_floor_ms=LATENCY_FLOOR_MS,
+):
+    """Pure roofline math -> the ``training.roofline`` field dict.
+
+    ``compiled`` is a :func:`note_compiled`-shaped dict (tests inject their
+    own); ``device_ms`` is the measured device-window time covering
+    ``rounds`` rounds, with ``source`` naming how it was measured
+    (``device_sync`` fence spans, or the ``residual`` of the round total
+    minus instrumented host phases)."""
+    rounds = max(int(rounds), 1)
+    flops_per_round = float(compiled.get("flops_per_round", 0.0) or 0.0)
+    bytes_per_round = float(compiled.get("bytes_per_round", 0.0) or 0.0)
+    seconds = max(float(device_ms), 0.0) / 1000.0
+    per_round_ms = device_ms / rounds if rounds else 0.0
+    achieved_flops = flops_per_round * rounds / seconds if seconds > 0 else 0.0
+    achieved_bytes = bytes_per_round * rounds / seconds if seconds > 0 else 0.0
+    intensity = flops_per_round / bytes_per_round if bytes_per_round > 0 else 0.0
+    if per_round_ms < latency_floor_ms:
+        binding = "latency"
+    elif intensity >= ridge:
+        binding = "compute"
+    else:
+        binding = "memory"
+    return {
+        "rounds": rounds,
+        "device_ms": round(float(device_ms), 3),
+        "device_ms_per_round": round(per_round_ms, 3),
+        "device_time_source": source,
+        "flops_per_round": round(flops_per_round, 1),
+        "bytes_per_round": round(bytes_per_round, 1),
+        "achieved_flops_per_sec": round(achieved_flops, 1),
+        "achieved_bytes_per_sec": round(achieved_bytes, 1),
+        "operational_intensity": round(intensity, 3),
+        "ridge_flops_per_byte": ridge,
+        "binding": binding,
+    }
+
+
+def maybe_roofline(device_ms, rounds, source, emit=False, extra=None):
+    """The gated roofline entrypoint: None when the plane is unarmed or no
+    compiled cost was introspected; otherwise the field dict, optionally
+    emitted as one ``training.roofline`` record and mirrored into
+    ``/status``."""
+    if not enabled():
+        return None
+    compiled = last_compiled()
+    if compiled is None or rounds <= 0:
+        return None
+    fields = roofline_fields(compiled, device_ms, rounds, source)
+    if extra:
+        fields.update(extra)
+    if emit:
+        emit_metric("training.roofline", **fields)
+        from . import fleet
+
+        fleet.note_status(roofline=fields)
+    return fields
+
+
+# ------------------------------------------------------------ OOM forensics
+def is_oom_error(exc):
+    """Does this exception look like a device allocator exhaustion? XLA
+    surfaces OOM as ``XlaRuntimeError: RESOURCE_EXHAUSTED: ...`` (the class
+    is backend-private, so match text, not type)."""
+    text = "{}: {}".format(type(exc).__name__, exc)
+    return (
+        "RESOURCE_EXHAUSTED" in text
+        or "Resource exhausted" in text
+        or "out of memory" in text.lower()
+    )
+
+
+def _top_live_buffers(top_n=32):
+    """Live device buffers grouped by (shape, dtype), largest total first —
+    the 'what is actually resident' table of the forensics dump."""
+    import jax
+
+    groups = {}
+    for arr in jax.live_arrays():
+        try:
+            key = (tuple(getattr(arr, "shape", ())), str(getattr(arr, "dtype", "?")))
+            entry = groups.setdefault(
+                key, {"shape": list(key[0]), "dtype": key[1], "count": 0, "total_bytes": 0}
+            )
+            entry["count"] += 1
+            entry["total_bytes"] += int(getattr(arr, "nbytes", 0))
+        except Exception:
+            continue
+    ranked = sorted(groups.values(), key=lambda e: -e["total_bytes"])
+    return ranked[:top_n]
+
+
+def _forensics_dir(default_dir=None):
+    """Durable-location ladder, mirroring the flight-recorder dump: the
+    explicit export dir, then the caller's hint (live checkpoint dir /
+    model dir), then the working directory."""
+    from . import tracing
+
+    explicit = os.environ.get(tracing.TRACE_EXPORT_DIR_ENV)
+    if explicit:
+        return explicit
+    if default_dir:
+        return default_dir
+    try:
+        from ..training import checkpointing
+
+        dirs = checkpointing.active_checkpoint_dirs()
+        if dirs:
+            return dirs[0]
+    except Exception:
+        pass
+    from ..constants import SM_MODEL_DIR
+
+    return os.environ.get(SM_MODEL_DIR) or "."
+
+
+def dump_oom_forensics(exc, default_dir=None, top_n=32):
+    """Write ``hbm-forensics-rank<r>.json`` for a device OOM: the error,
+    a fresh allocator walk, the top live buffers by footprint, the compiled
+    program's memory analysis, and the last watermark. Robustness path —
+    runs regardless of ``SM_DEVICE_TELEMETRY`` (an OOM'd job's last act
+    should always name the buffers that killed it). Never raises; returns
+    the path or None."""
+    try:
+        from . import tracing
+
+        rank = tracing.get_rank()
+        doc = {
+            "reason": "device_oom",
+            "rank": rank,
+            "error": str(exc)[:2000],
+        }
+        try:
+            doc["memory"] = sample_device_memory(max_age_s=0.0)
+        except Exception:
+            pass
+        try:
+            doc["top_live_buffers"] = _top_live_buffers(top_n)
+        except Exception:
+            pass
+        with _state_lock:
+            if _last_compiled is not None:
+                doc["compiled"] = dict(_last_compiled)
+            if _last_watermark is not None:
+                doc["last_watermark"] = dict(_last_watermark)
+                doc["watermark_high_bytes"] = _watermark_high
+        directory = _forensics_dir(default_dir)
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "hbm-forensics-rank{}.json".format(rank))
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+            f.write("\n")
+        logger.error(
+            "device OOM: HBM forensics (top live buffers, allocator stats, "
+            "compiled memory analysis) dumped to %s", path
+        )
+        return path
+    except Exception:
+        logger.exception("HBM forensics dump failed; aborting anyway")
+        return None
+
+
+def _reset_for_tests():
+    global _last_compiled, _last_watermark, _watermark_high, _sample_cache
+    with _state_lock:
+        _last_compiled = None
+        _last_watermark = None
+        _watermark_high = 0
+    with _sample_lock:
+        _sample_cache = None

@@ -83,6 +83,23 @@ _MAX_OPEN_ROUNDS = 128
 
 _MAX_BACKOFF_S = 60.0
 
+#: a rank holding > this factor x the median live HBM is memory-skewed
+_MEMORY_SKEW_FACTOR = 1.5
+
+#: /debug/profile bounds: capture length cap and the busy lock (one capture
+#: at a time — jax.profiler sessions are process-global)
+_PROFILE_MAX_MS = 10000
+_profile_lock = threading.Lock()
+_profile_seq = [0]
+
+_HTTP_STATUS = {
+    200: "200 OK",
+    400: "400 Bad Request",
+    404: "404 Not Found",
+    409: "409 Conflict",
+    500: "500 Internal Server Error",
+}
+
 #: child-span name -> attribution component (the round root's remainder is
 #: "wire": time the critical rank spent that no instrumented phase explains)
 _PHASE_SPANS = {
@@ -139,6 +156,30 @@ def note_attribution(fields):
 def status_snapshot():
     with _status_lock:
         return dict(_status)
+
+
+def _memory_doc(collector=None):
+    """The HBM/memory section shared by ``/status`` and the SIGQUIT dump:
+    this rank's device-plane view (current sample, watermark, compiled
+    peak) plus, on rank 0, the per-rank watermarks the shipper delivered
+    and the memory-skew verdict. {} when the device plane is unarmed and
+    no rank ever shipped a watermark — the section simply doesn't render."""
+    doc = {}
+    try:
+        from . import device
+
+        local = device.memory_status()
+        if local:
+            doc["local"] = local
+    except Exception:
+        logger.debug("local memory status unavailable", exc_info=True)
+    if collector is not None:
+        snap = collector.memory_snapshot()
+        if snap.get("ranks"):
+            doc["ranks"] = snap["ranks"]
+            if "memory_skew" in snap:
+                doc["memory_skew"] = snap["memory_skew"]
+    return doc
 
 
 # ------------------------------------------------------------------ shipper
@@ -216,6 +257,17 @@ class SpanShipper:
                     self._last_seq = span.seq
         return fresh
 
+    def _memory_wire(self):
+        """The device plane's latest HBM watermark (None when unarmed or
+        never sampled) — rides the next span frame so rank 0 can fold a
+        per-rank memory view without a second control-plane socket."""
+        try:
+            from . import device
+
+            return device.watermark_wire()
+        except Exception:
+            return None
+
     def send_once(self):
         """One bounded flush attempt; returns True when nothing remains
         pending. Never raises — delivery failure is counted, backed off,
@@ -229,12 +281,18 @@ class SpanShipper:
                 self._m_dropped.inc(dropped)
                 logger.debug("fleet retry queue full; dropped %d spans", dropped)
             batch = list(self._pending)
-        if not batch:
+        memory = self._memory_wire()
+        if not batch and memory is None:
             return True
         sent = 0
         try:
-            for start in range(0, len(batch), _BATCH_SPANS):
-                chunk = batch[start : start + _BATCH_SPANS]
+            # a watermark with no spans still ships: one frame with an
+            # empty span list carries it (the collector folds both)
+            chunks = [
+                batch[start : start + _BATCH_SPANS]
+                for start in range(0, len(batch), _BATCH_SPANS)
+            ] or [[]]
+            for index, chunk in enumerate(chunks):
                 payload = {
                     "type": "spans",
                     "v": FLEET_VERSION,
@@ -242,6 +300,8 @@ class SpanShipper:
                     "host": self.host,
                     "spans": chunk,
                 }
+                if index == 0 and memory is not None:
+                    payload["memory"] = memory
                 sock = socket.create_connection(self.collector_addr, timeout=self.timeout)
                 try:
                     sock.settimeout(self.timeout)
@@ -314,6 +374,7 @@ class FleetCollector:
         self._running = {r: dict.fromkeys(_COMPONENTS, 0.0) for r in range(self.num_ranks)}
         self._rounds = {}  # round index -> {rank: per-rank entry}
         self._skew = collections.deque(maxlen=_SKEW_HISTORY)
+        self._memory = {}  # rank -> latest HBM watermark (device plane)
         self._m_received = {
             r: self._reg.counter(
                 "fleet_spans_received_total",
@@ -360,6 +421,12 @@ class FleetCollector:
         if not 0 <= rank < self.num_ranks:
             logger.warning("dropping span batch from unknown rank %r", rank)
             return False
+        memory = payload.get("memory")
+        if isinstance(memory, dict):
+            entry = dict(memory)
+            entry["host"] = payload.get("host")
+            with self._lock:
+                self._memory[rank] = entry
         spans = payload.get("spans")
         if not isinstance(spans, list):
             return False
@@ -459,6 +526,32 @@ class FleetCollector:
         with self._lock:
             return {r: len(buf) for r, buf in self._spans.items()}
 
+    def memory_snapshot(self):
+        """Per-rank HBM watermarks + a memory-skew verdict: the rank whose
+        live bytes exceed 1.5x the cross-rank median (>= 2 reporting ranks)
+        is named, so skew attribution can say *memory*-skewed, not just
+        slow. Empty ``ranks`` when the device plane never shipped."""
+        with self._lock:
+            per_rank = {r: dict(m) for r, m in self._memory.items()}
+        doc = {"ranks": per_rank}
+        values = {
+            r: m.get("bytes_in_use", 0)
+            for r, m in per_rank.items()
+            if isinstance(m.get("bytes_in_use"), (int, float))
+        }
+        if len(values) >= 2:
+            median = percentile(list(values.values()), 0.5)
+            worst = max(values, key=values.get)
+            if median > 0 and values[worst] > _MEMORY_SKEW_FACTOR * median:
+                doc["memory_skew"] = {
+                    "rank": worst,
+                    "host": per_rank[worst].get("host"),
+                    "bytes_in_use": int(values[worst]),
+                    "median_bytes": int(median),
+                    "ratio": round(values[worst] / median, 2),
+                }
+        return doc
+
     def merged_doc(self, extra_metadata=None):
         """-> the merged Chrome-trace dict: one pid=rank lane per rank that
         shipped spans, built by the same event builder as the per-rank
@@ -553,6 +646,9 @@ class StatusServer:
       error, serving SLO snapshot when armed.
     * ``GET /debug/flight`` — the live span snapshot (finished ring buffer
       + in-flight spans), i.e. the flight recorder without the abort.
+    * ``GET /debug/profile?ms=N`` — a bounded on-demand ``jax.profiler``
+      capture into ``SM_PROFILER_TRACE_DIR`` (404 while unarmed), so a
+      live wedged job can be profiled without restarting it.
     """
 
     def __init__(self, port, collector=None):
@@ -562,10 +658,15 @@ class StatusServer:
 
         def app(environ, start_response):
             path = environ.get("PATH_INFO", "/")
+            status = _HTTP_STATUS[200]
             if path in ("/", "/status"):
                 body = json.dumps(self.status_doc()).encode("utf-8")
             elif path == "/debug/flight":
                 body = json.dumps(self.flight_doc()).encode("utf-8")
+            elif path == "/debug/profile":
+                code, doc = self.profile_doc(environ.get("QUERY_STRING", ""))
+                status = _HTTP_STATUS[code]
+                body = json.dumps(doc).encode("utf-8")
             else:
                 body = b"not found"
                 start_response(
@@ -577,7 +678,7 @@ class StatusServer:
                 )
                 return [body]
             start_response(
-                "200 OK",
+                status,
                 [
                     ("Content-Type", "application/json"),
                     ("Content-Length", str(len(body))),
@@ -627,7 +728,55 @@ class StatusServer:
         window = active_window()
         if window is not None:
             doc["slo"] = window.snapshot()
+        memory = _memory_doc(self._collector)
+        if memory:
+            doc["memory"] = memory
         return doc
+
+    def profile_doc(self, query):
+        """``GET /debug/profile?ms=N`` -> (http code, doc): a bounded
+        programmatic ``jax.profiler`` capture into ``SM_PROFILER_TRACE_DIR``
+        so a live wedged job can be profiled without restarting it. 404
+        when the trace dir isn't armed (indistinguishable from an unknown
+        path, like the /metrics gate), 409 while another capture runs,
+        capture length capped at ``_PROFILE_MAX_MS``."""
+        from ..training.profiling import TRACE_DIR_ENV
+        from urllib.parse import parse_qs
+
+        trace_dir = os.environ.get(TRACE_DIR_ENV)
+        if not trace_dir:
+            return 404, {
+                "error": "profiling unarmed: set {} to enable on-demand "
+                "captures".format(TRACE_DIR_ENV)
+            }
+        try:
+            ms = int(parse_qs(query or "").get("ms", ["1000"])[0])
+        except (ValueError, IndexError):
+            return 400, {"error": "ms must be an integer"}
+        ms = max(1, min(ms, _PROFILE_MAX_MS))
+        if not _profile_lock.acquire(blocking=False):
+            return 409, {"error": "a profile capture is already running"}
+        try:
+            import jax
+
+            with _status_lock:
+                _profile_seq[0] += 1
+                seq = _profile_seq[0]
+            out_dir = os.path.join(trace_dir, "ondemand-{}".format(seq))
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:
+            logger.warning("on-demand profile capture failed: %s", e)
+            return 500, {"error": str(e)[:400]}
+        finally:
+            _profile_lock.release()
+        emit_metric("training.profile_capture", path=out_dir, ms=ms)
+        logger.info("on-demand XLA profile (%d ms) captured to %s", ms, out_dir)
+        return 200, {"path": out_dir, "ms": ms}
 
     def flight_doc(self):
         spans = [
@@ -754,8 +903,11 @@ def start_fleet_plane(hosts, current_host, registry=None):
     if status_port and rank == 0:
         try:
             status_server = StatusServer(status_port, collector=collector).start()
-            logger.info("status endpoint on port %d (/status, /debug/flight)",
-                        status_server.port)
+            logger.info(
+                "status endpoint on port %d (/status, /debug/flight, "
+                "/debug/profile)",
+                status_server.port,
+            )
         except OSError as e:
             logger.warning("status port %d unavailable: %s", status_port, e)
     plane = FleetPlane(
@@ -814,6 +966,9 @@ def _sigquit_dump(default_dir):
         if plane is not None and plane.collector is not None:
             doc["skew"] = plane.collector.skew_snapshot()
             doc["fleet_spans"] = plane.collector.span_counts()
+        memory = _memory_doc(plane.collector if plane is not None else None)
+        if memory:
+            doc["memory"] = memory
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(
             directory, "fleet-status-rank{}.json".format(tracing.get_rank())

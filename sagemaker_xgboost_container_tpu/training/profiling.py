@@ -32,6 +32,7 @@ from ..telemetry import (
     tracing,
 )
 from ..telemetry import percentile  # noqa: F401  (canonical home: telemetry.registry)
+from ..telemetry import device as device_telemetry
 from ..utils.envconfig import env_int
 from ..utils.faults import fault_point
 
@@ -65,6 +66,10 @@ class RoundTimer:
         self.emit_structured = emit_structured
         self.fold = fold
         self._attr_every = env_int(ATTRIBUTION_EVERY_ENV, 0, minimum=0)
+        # HBM watermark cadence (SM_DEVICE_TELEMETRY + SM_HBM_SAMPLE_EVERY):
+        # 0 when the device plane is unarmed — resolved once here so the
+        # per-round path never reads env
+        self._hbm_every = device_telemetry.sample_cadence()
         self._last = None
         self._times = []
         self._recorder = None
@@ -107,6 +112,11 @@ class RoundTimer:
             # feed the cluster heartbeat's round state (telemetry/cluster.py):
             # a deque append under a lock — negligible, so always on
             ROUND_STATE.note_round(epoch, elapsed)
+            if self._hbm_every and epoch % self._hbm_every == 0:
+                # per-round HBM watermark (shares the cached device-memory
+                # walk with the heartbeat plane; ships to rank 0 with the
+                # next span frame)
+                device_telemetry.sample_watermark(epoch)
             phases = self._recorder.drain() if self._recorder is not None else {}
             compile_now = compile_stats()["seconds"]
             compile_delta = (
@@ -213,7 +223,28 @@ class RoundTimer:
                     fields["fold"] = self.fold
                 emit_metric("training.summary", **fields)
                 self._emit_attribution(total)
+                # roofline record (device plane): the measured device window
+                # against the compiled cost — one record per training run
+                device_ms, source = self._device_window_ms(total)
+                extra = {"fold": self.fold} if self.fold is not None else None
+                device_telemetry.maybe_roofline(
+                    device_ms, len(self._times), source, emit=True, extra=extra
+                )
         return model
+
+    def _device_window_ms(self, total_s):
+        """-> (device-window ms, source): the fenced ``device_sync`` span
+        totals when SM_TRACE_DEVICE_SYNC was armed, else the residual of
+        the round totals minus every instrumented host phase and compile —
+        the same remainder the round records call ``build_eval``."""
+        device_s = self._phase_totals.get("device_sync", 0.0)
+        if device_s > 0:
+            return device_s * 1000.0, "device_sync"
+        residual = max(
+            total_s - sum(self._phase_totals.values()) - self._compile_total_s,
+            0.0,
+        )
+        return residual * 1000.0, "residual"
 
     def _emit_attribution(self, total_s, rolling=False, round_index=None):
         """One ``training.attribution`` record: where the run's wall time
@@ -236,6 +267,20 @@ class RoundTimer:
             collective_ms=float(comm_per_round) * len(self._times),
         )
         fields["rounds"] = len(self._times)
+        # mirror the roofline verdict (device plane; None when unarmed or
+        # nothing introspected) so attribution says WHY the device share is
+        # what it is, not just how big it is
+        device_ms, source = self._device_window_ms(total_s)
+        roofline = device_telemetry.maybe_roofline(
+            device_ms, len(self._times), source
+        )
+        if roofline is not None:
+            fields["roofline"] = {
+                "binding": roofline["binding"],
+                "achieved_flops_per_sec": roofline["achieved_flops_per_sec"],
+                "achieved_bytes_per_sec": roofline["achieved_bytes_per_sec"],
+                "operational_intensity": roofline["operational_intensity"],
+            }
         if rolling:
             fields["rolling"] = True
         if round_index is not None:

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Fold per-PR bench snapshots into one performance-trajectory report.
+
+The driver leaves ``BENCH_r*.json`` (single-host bench.py runs) and
+``MULTICHIP_r*.json`` (multi-device smoke results) at the repo root, one per
+PR round. Each snapshot is a point; nobody looks at the line. This tool folds
+them into a single trajectory document — rounds/sec, vs_baseline, and whether
+the backend probe failed, per snapshot — so a regression shows up as a bend
+in the curve rather than a forgotten file.
+
+Usage:
+    python scripts/bench_trend.py                 # report on stdout
+    python scripts/bench_trend.py --out trend.json
+    python scripts/bench_trend.py --gate 0.15     # exit 1 if the newest
+                                                  # snapshot regressed >15%
+                                                  # below the best prior one
+
+Gate semantics: only snapshots from the same measurement family (same
+backend-fallback status) are compared, so a CPU-fallback point is never
+gated against a real accelerator point. Exit codes: 0 ok, 1 regression
+beyond tolerance, 2 tool error (unreadable snapshot, no data).
+
+``ci.sh full`` runs this and archives the report under
+``$CI_ARTIFACT_DIR/bench/``.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _snapshot_n(path, doc):
+    """Round index: the 'n' key, else the r<NN> filename suffix, else -1."""
+    n = doc.get("n")
+    if isinstance(n, int):
+        return n
+    m = re.search(r"r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _bench_point(path, doc):
+    parsed = doc.get("parsed") or {}
+    metric = parsed.get("metric", "")
+    point = {
+        "n": _snapshot_n(path, doc),
+        "file": os.path.basename(path),
+        "rc": doc.get("rc"),
+        "value": parsed.get("value"),
+        "unit": parsed.get("unit"),
+        "vs_baseline": parsed.get("vs_baseline"),
+        "cpu_fallback": "[CPU FALLBACK" in metric,
+        "backend_init_error": bool(parsed.get("backend_init_error")),
+    }
+    # newer bench.py lines carry richer shape — surface it when present
+    for key in ("p50_ms", "p95_ms", "rounds_per_dispatch"):
+        if key in parsed:
+            point[key] = parsed[key]
+    roofline = parsed.get("roofline")
+    if isinstance(roofline, dict):
+        point["roofline_binding"] = roofline.get("binding")
+    return point
+
+
+def _multichip_point(path, doc):
+    return {
+        "n": _snapshot_n(path, doc),
+        "file": os.path.basename(path),
+        "n_devices": doc.get("n_devices"),
+        "rc": doc.get("rc"),
+        "ok": doc.get("ok"),
+        "skipped": doc.get("skipped"),
+    }
+
+
+def build_report(snapshot_dir):
+    """Fold every BENCH_*/MULTICHIP_* snapshot in ``snapshot_dir`` into one
+    trajectory doc (points sorted by round index)."""
+    bench, multichip, errors = [], [], []
+    for path in sorted(glob.glob(os.path.join(snapshot_dir, "BENCH_*.json"))):
+        try:
+            bench.append(_bench_point(path, _load(path)))
+        except (OSError, ValueError) as e:
+            errors.append({"file": os.path.basename(path), "error": str(e)})
+    for path in sorted(glob.glob(os.path.join(snapshot_dir, "MULTICHIP_*.json"))):
+        try:
+            multichip.append(_multichip_point(path, _load(path)))
+        except (OSError, ValueError) as e:
+            errors.append({"file": os.path.basename(path), "error": str(e)})
+    bench.sort(key=lambda p: p["n"])
+    multichip.sort(key=lambda p: p["n"])
+
+    values = [p["value"] for p in bench if isinstance(p["value"], (int, float))]
+    summary = {}
+    if values:
+        latest = bench[-1]
+        summary = {
+            "snapshots": len(bench),
+            "latest_n": latest["n"],
+            "latest_value": latest["value"],
+            "latest_vs_baseline": latest["vs_baseline"],
+            "best_value": max(values),
+            "worst_value": min(values),
+            "any_backend_init_error": any(p["backend_init_error"] for p in bench),
+            "all_cpu_fallback": all(p["cpu_fallback"] for p in bench),
+        }
+    return {
+        "report": "bench_trend",
+        "dir": os.path.abspath(snapshot_dir),
+        "bench": bench,
+        "multichip": multichip,
+        "summary": summary,
+        "errors": errors,
+    }
+
+
+def gate(report, tolerance):
+    """Regression check: newest bench value vs the best PRIOR value in the
+    same family (same cpu_fallback flag). Returns (ok, message)."""
+    bench = report["bench"]
+    usable = [p for p in bench if isinstance(p.get("value"), (int, float))]
+    if len(usable) < 2:
+        return True, "gate skipped: fewer than 2 comparable snapshots"
+    newest = usable[-1]
+    prior = [
+        p for p in usable[:-1] if p["cpu_fallback"] == newest["cpu_fallback"]
+    ]
+    if not prior:
+        return True, (
+            "gate skipped: no prior snapshot in the same backend family "
+            "(newest cpu_fallback={})".format(newest["cpu_fallback"])
+        )
+    best_prior = max(p["value"] for p in prior)
+    floor = best_prior * (1.0 - tolerance)
+    if newest["value"] < floor:
+        return False, (
+            "REGRESSION: snapshot n={} at {:.3f} {} is {:.1f}% below the "
+            "best prior ({:.3f}), tolerance {:.0f}%".format(
+                newest["n"], newest["value"], newest.get("unit") or "",
+                (1.0 - newest["value"] / best_prior) * 100.0,
+                best_prior, tolerance * 100.0,
+            )
+        )
+    return True, (
+        "ok: snapshot n={} at {:.3f} within {:.0f}% of best prior {:.3f}".format(
+            newest["n"], newest["value"], tolerance * 100.0, best_prior
+        )
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_*.json / MULTICHIP_*.json (default: repo root)",
+    )
+    ap.add_argument("--out", default=None, help="write the report to this file")
+    ap.add_argument(
+        "--gate",
+        type=float,
+        default=None,
+        metavar="TOL",
+        help="fail (exit 1) if the newest snapshot is more than TOL "
+        "(fraction, e.g. 0.15) below the best prior same-family value",
+    )
+    args = ap.parse_args(argv)
+
+    report = build_report(args.dir)
+    if not report["bench"] and not report["multichip"]:
+        sys.stderr.write("bench_trend: no snapshots found in {}\n".format(args.dir))
+        return 2
+    if report["errors"]:
+        for err in report["errors"]:
+            sys.stderr.write(
+                "bench_trend: unreadable snapshot {file}: {error}\n".format(**err)
+            )
+
+    rc = 0
+    if args.gate is not None:
+        ok, message = gate(report, args.gate)
+        report["gate"] = {"tolerance": args.gate, "ok": ok, "message": message}
+        sys.stderr.write("bench_trend gate: {}\n".format(message))
+        if not ok:
+            rc = 1
+
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.out:
+        out_dir = os.path.dirname(os.path.abspath(args.out))
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        sys.stderr.write("bench_trend: report written to {}\n".format(args.out))
+    else:
+        sys.stdout.write(text + "\n")
+    return rc if not report["errors"] else (rc or 2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,6 +131,20 @@ if [ $rc -eq 0 ] && [ "$TIER" = "full" ]; then
   fi
 fi
 
+# bench trajectory (full): fold the per-PR BENCH_*/MULTICHIP_* snapshots at
+# the repo root into one trend report so a perf regression reads as a bend
+# in the curve; archived next to the bench-smoke artifact. Reporting-only
+# here (no --gate) — the snapshots are driver-owned history, not this run.
+if [ $rc -eq 0 ] && [ "$TIER" = "full" ]; then
+  if python "$REPO/scripts/bench_trend.py" --dir "$REPO" \
+      --out "$ARTIFACT_DIR/bench/bench_trend.json"; then
+    echo "bench trend: OK (artifact: $ARTIFACT_DIR/bench/bench_trend.json)"
+  else
+    rc=1
+    echo "CI $TIER TIER FAILED (bench trend; see $ARTIFACT_DIR/bench)"
+  fi
+fi
+
 # fused-dispatch smoke (full): bounded K=1 vs K=4 micro-run asserting the
 # fused lax.scan round pipeline is bit-identical and not slower; the
 # measured JSON is archived next to the trace/graftlint artifacts

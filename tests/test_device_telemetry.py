@@ -1,0 +1,380 @@
+"""Device-window plane (telemetry/device.py, SM_DEVICE_TELEMETRY).
+
+Covers the unset-gate guard (no records, no threads, bit-identical trees vs
+an armed run — AOT lowering must not consume the RNG stream), the
+``training.compiled`` record shape on a tiny mesh train, the roofline math
+with injected costs (compute / memory / latency binding), the HBM watermark
+cadence (SM_HBM_SAMPLE_EVERY) and wire shape, the shared cached sampler the
+heartbeat plane delegates to, the OOM forensics drill (injected
+RESOURCE_EXHAUSTED -> hbm-forensics-rank0.json + exit 86), the /status
+memory section + memory-skew naming, and the on-demand /debug/profile
+endpoint (bounded capture when armed, 404 when not).
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.constants import EXIT_DEVICE_OOM
+from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+from sagemaker_xgboost_container_tpu.models import train
+from sagemaker_xgboost_container_tpu.models.booster import (
+    TrainConfig,
+    _TrainingSession,
+)
+from sagemaker_xgboost_container_tpu.models.forest import Forest
+from sagemaker_xgboost_container_tpu.telemetry import device, fleet, tracing
+from sagemaker_xgboost_container_tpu.training import watchdog
+from sagemaker_xgboost_container_tpu.training.profiling import RoundTimer
+
+
+def _records(out, metric):
+    needle = '"metric": "{}"'.format(metric)
+    return [json.loads(l) for l in out.splitlines() if needle in l]
+
+
+@pytest.fixture
+def device_env(monkeypatch):
+    for knob in (
+        device.DEVICE_TELEMETRY_ENV,
+        device.HBM_SAMPLE_EVERY_ENV,
+        "SM_PROFILER_TRACE_DIR",
+        tracing.TRACE_EXPORT_DIR_ENV,
+    ):
+        monkeypatch.delenv(knob, raising=False)
+    device._reset_for_tests()
+    fleet._reset_for_tests()
+    yield monkeypatch
+    device._reset_for_tests()
+    fleet._reset_for_tests()
+
+
+def _tiny_data(n=256, d=5):
+    rng = np.random.RandomState(7)
+    X = rng.rand(n, d).astype(np.float32)
+    y = (X @ rng.rand(d).astype(np.float32) > 0.5).astype(np.float32)
+    return X, y
+
+
+def _train_tiny(mesh=None, rounds=4, timer=False):
+    X, y = _tiny_data()
+    # the entrypoint layer installs RoundTimer (training/callbacks.py); tests
+    # that assert the roofline/watermark path add it explicitly
+    callbacks = [RoundTimer(log_every=0)] if timer else None
+    return train(
+        {"max_depth": 3, "objective": "binary:logistic"},
+        DataMatrix(X, labels=y),
+        num_boost_round=rounds,
+        verbose_eval=False,
+        mesh=mesh,
+        callbacks=callbacks,
+    )
+
+
+# ------------------------------------------------------------- the gate off
+def test_gate_off_no_records_no_threads(device_env, capsys):
+    before = set(threading.enumerate())
+    _train_tiny(timer=True)
+    out = capsys.readouterr().out
+    assert _records(out, "training.compiled") == []
+    assert _records(out, "training.roofline") == []
+    assert set(threading.enumerate()) == before
+    assert device.sample_cadence() == 0
+    assert device.watermark_wire() is None
+    assert device.memory_status() is None
+    assert device.maybe_roofline(100.0, 4, "residual") is None
+
+
+def test_gate_does_not_change_trees(device_env, tmp_path, capsys):
+    """Arming the plane must be pure observation: the AOT lowering reads
+    avals only, so the tree stream is bit-identical with and without it."""
+    off = _train_tiny()
+    device_env.setenv(device.DEVICE_TELEMETRY_ENV, "1")
+    device._reset_for_tests()
+    on = _train_tiny()
+    capsys.readouterr()
+    p_off, p_on = str(tmp_path / "off.json"), str(tmp_path / "on.json")
+    off.save_model(p_off)
+    on.save_model(p_on)
+    with open(p_off, "rb") as f_off, open(p_on, "rb") as f_on:
+        assert f_off.read() == f_on.read()
+
+
+# ------------------------------------------------------- compiled-cost record
+def test_compiled_record_on_tiny_mesh_train(device_env, capsys):
+    import jax
+    from jax.sharding import Mesh
+
+    device_env.setenv(device.DEVICE_TELEMETRY_ENV, "1")
+    mesh = Mesh(np.array(jax.devices()[:2]), axis_names=("data",))
+    _train_tiny(mesh=mesh, timer=True)
+    out = capsys.readouterr().out
+    compiled = _records(out, "training.compiled")
+    assert len(compiled) == 1
+    rec = compiled[0]
+    assert rec["kind"] == "train_round"
+    assert rec["flops"] > 0
+    assert rec["bytes_accessed"] > 0
+    assert rec["flops_per_round"] > 0
+    assert rec["hbm_peak_bytes"] >= 0
+    assert rec["rounds_per_dispatch"] >= 1
+    assert rec["mesh_shape"] == {"data": 2}
+    assert rec["backend"] == "cpu"
+    # the roofline record rode the same run
+    rooflines = _records(out, "training.roofline")
+    assert len(rooflines) == 1
+    roof = rooflines[0]
+    assert roof["binding"] in ("compute", "memory", "latency")
+    assert roof["device_time_source"] in ("device_sync", "residual")
+    assert roof["rounds"] == 4
+    assert roof["achieved_flops_per_sec"] >= 0
+    # and the record survived for /status + forensics
+    last = device.last_compiled()
+    assert last is not None and last["flops"] == rec["flops"]
+
+
+# ------------------------------------------------------------- roofline math
+def test_roofline_compute_bound_units():
+    compiled = {"flops_per_round": 1e6, "bytes_per_round": 1e4}
+    fields = device.roofline_fields(compiled, device_ms=1000.0, rounds=10)
+    # 1e6 flops x 10 rounds over 1 second
+    assert fields["achieved_flops_per_sec"] == pytest.approx(1e7)
+    assert fields["achieved_bytes_per_sec"] == pytest.approx(1e5)
+    assert fields["operational_intensity"] == pytest.approx(100.0)
+    assert fields["binding"] == "compute"
+    assert fields["device_ms_per_round"] == pytest.approx(100.0)
+    assert fields["ridge_flops_per_byte"] == device.DEFAULT_RIDGE_FLOPS_PER_BYTE
+
+
+def test_roofline_memory_bound():
+    compiled = {"flops_per_round": 1e4, "bytes_per_round": 1e4}
+    fields = device.roofline_fields(compiled, device_ms=1000.0, rounds=10)
+    assert fields["operational_intensity"] == pytest.approx(1.0)
+    assert fields["binding"] == "memory"
+
+
+def test_roofline_latency_floor():
+    # 0.1 ms/round of device time: the dispatch floor, not the program
+    compiled = {"flops_per_round": 1e9, "bytes_per_round": 1.0}
+    fields = device.roofline_fields(compiled, device_ms=1.0, rounds=10)
+    assert fields["binding"] == "latency"
+
+
+# ------------------------------------------------------------ HBM watermarks
+def test_watermark_cadence(device_env, monkeypatch):
+    device_env.setenv(device.DEVICE_TELEMETRY_ENV, "1")
+    device_env.setenv(device.HBM_SAMPLE_EVERY_ENV, "3")
+    sampled = []
+    monkeypatch.setattr(device, "sample_watermark", sampled.append)
+    timer = RoundTimer(log_every=0, emit_structured=False)
+    assert timer._hbm_every == 3
+    timer.before_training(None)
+    for epoch in range(9):
+        timer.after_iteration(None, epoch, {})
+    timer.after_training(None)
+    assert sampled == [0, 3, 6]
+
+
+def test_watermark_state_and_wire(device_env):
+    device_env.setenv(device.DEVICE_TELEMETRY_ENV, "1")
+    mark = device.sample_watermark(5)
+    assert mark["round"] == 5
+    assert mark["source"] in ("memory_stats", "live_arrays", "none")
+    wire = device.watermark_wire()
+    assert wire["round"] == 5
+    assert wire["high_bytes"] >= wire["bytes_in_use"] >= 0
+    status = device.memory_status()
+    assert status["watermark"]["round"] == 5
+    assert "current" in status
+
+
+def test_sampler_is_shared_and_cached(device_env, monkeypatch):
+    """Satellite: the heartbeat plane's device_live_bytes and the watermark
+    walk must share ONE cached sample — at most one live-buffer walk per
+    interval however many consumers fire."""
+    from sagemaker_xgboost_container_tpu.telemetry import cluster
+
+    walks = []
+    real = device._sample_uncached
+    monkeypatch.setattr(
+        device, "_sample_uncached", lambda: (walks.append(1), real())[1]
+    )
+    device._reset_for_tests()
+    first = device.sample_device_memory()
+    cluster._device_live_bytes()
+    device.sample_device_memory()
+    assert len(walks) == 1
+    assert cluster._device_live_bytes() == int(first["total_bytes_in_use"])
+    # max_age_s=0 (forensics) forces a fresh walk through the cache
+    device.sample_device_memory(max_age_s=0.0)
+    assert len(walks) == 2
+
+
+# ------------------------------------------------------------- OOM forensics
+def _tiny_session():
+    X, y = _tiny_data(64, 4)
+    config = TrainConfig({"max_depth": 2, "objective": "reg:squarederror"})
+    dtrain = DataMatrix(X, labels=y)
+    forest = Forest(
+        objective_name=config.objective,
+        objective_params=None,
+        base_score=config.base_score,
+        num_feature=dtrain.num_col,
+        num_class=config.num_class,
+    )
+    return _TrainingSession(config, dtrain, [], forest, mesh=None)
+
+
+def test_oom_drill_dumps_forensics_and_exits_86(
+    device_env, tmp_path, monkeypatch, capsys
+):
+    device_env.setenv(tracing.TRACE_EXPORT_DIR_ENV, str(tmp_path))
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    watchdog._reset_abort_for_tests()
+    session = _tiny_session()
+
+    def _boom():
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "9876543210 bytes."
+        )
+
+    monkeypatch.setattr(session, "_run_rounds_inner", _boom)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        session.run_rounds()
+    assert codes == [EXIT_DEVICE_OOM]
+    path = tmp_path / "hbm-forensics-rank0.json"
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    assert doc["reason"] == "device_oom"
+    assert "RESOURCE_EXHAUSTED" in doc["error"]
+    assert isinstance(doc["top_live_buffers"], list) and doc["top_live_buffers"]
+    assert doc["memory"]["source"] in ("memory_stats", "live_arrays", "none")
+    aborts = _records(capsys.readouterr().out, "training.abort")
+    assert aborts and aborts[0]["reason"] == "device_oom"
+    assert aborts[0]["forensics"] == str(path)
+    watchdog._reset_abort_for_tests()
+
+
+def test_non_oom_errors_propagate_without_abort(device_env, monkeypatch):
+    codes = []
+    monkeypatch.setattr(watchdog, "_exit", codes.append)
+    watchdog._reset_abort_for_tests()
+    session = _tiny_session()
+
+    def _boom():
+        raise ValueError("not a memory problem")
+
+    monkeypatch.setattr(session, "_run_rounds_inner", _boom)
+    with pytest.raises(ValueError):
+        session.run_rounds()
+    assert codes == []
+    watchdog._reset_abort_for_tests()
+
+
+def test_is_oom_error_matches_xla_text_only():
+    assert device.is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert device.is_oom_error(RuntimeError("Resource exhausted: HBM"))
+    assert device.is_oom_error(MemoryError("ran out of memory on device"))
+    assert not device.is_oom_error(ValueError("shapes do not match"))
+
+
+# ----------------------------------------------- /status memory + /debug/profile
+def test_status_memory_section_and_skew(device_env):
+    device_env.setenv(device.DEVICE_TELEMETRY_ENV, "1")
+    device.sample_watermark(2)
+    collector = fleet.FleetCollector(num_ranks=3, port=0)
+    try:
+        for rank, bytes_in_use in ((0, 100), (1, 120), (2, 1000)):
+            assert collector.fold(
+                {
+                    "type": "spans",
+                    "rank": rank,
+                    "host": "algo-{}".format(rank + 1),
+                    "spans": [],
+                    "memory": {"round": 2, "bytes_in_use": bytes_in_use},
+                }
+            )
+        snap = collector.memory_snapshot()
+        assert set(snap["ranks"]) == {0, 1, 2}
+        skew = snap["memory_skew"]
+        assert skew["rank"] == 2 and skew["host"] == "algo-3"
+        assert skew["ratio"] > 1.5
+        server = fleet.StatusServer(0, collector=collector).start()
+        try:
+            with urllib.request.urlopen(
+                "http://127.0.0.1:{}/status".format(server.port), timeout=10
+            ) as resp:
+                doc = json.loads(resp.read())
+            memory = doc["memory"]
+            assert memory["local"]["watermark"]["round"] == 2
+            assert memory["memory_skew"]["rank"] == 2
+        finally:
+            server.stop()
+    finally:
+        collector.stop()
+
+
+def test_debug_profile_capture_and_404(device_env, tmp_path):
+    server = fleet.StatusServer(0).start()
+    url = "http://127.0.0.1:{}/debug/profile?ms=10".format(server.port)
+    try:
+        # unarmed: indistinguishable from an unknown path
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url, timeout=10)
+        assert err.value.code == 404
+        device_env.setenv("SM_PROFILER_TRACE_DIR", str(tmp_path))
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert doc["ms"] == 10
+        assert doc["path"].startswith(str(tmp_path))
+        assert os.path.isdir(doc["path"])
+        # bad ms is a 400, not a crash
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                "http://127.0.0.1:{}/debug/profile?ms=soon".format(server.port),
+                timeout=10,
+            )
+        assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------- bench_trend
+def test_bench_trend_report_and_gate(tmp_path):
+    from scripts.bench_trend import build_report, gate
+
+    def snap(n, value, fallback=False):
+        metric = "rounds/sec" + (" [CPU FALLBACK - x]" if fallback else "")
+        (tmp_path / "BENCH_r{:02d}.json".format(n)).write_text(
+            json.dumps(
+                {
+                    "n": n,
+                    "rc": 0,
+                    "parsed": {"metric": metric, "value": value, "unit": "rounds/sec"},
+                }
+            )
+        )
+
+    snap(1, 1.0, fallback=True)
+    snap(2, 2.0)
+    snap(3, 1.0)
+    (tmp_path / "MULTICHIP_r03.json").write_text(
+        json.dumps({"n_devices": 8, "rc": 0, "ok": True, "skipped": False})
+    )
+    report = build_report(str(tmp_path))
+    assert [p["n"] for p in report["bench"]] == [1, 2, 3]
+    assert report["summary"]["best_value"] == 2.0
+    assert report["multichip"][0]["ok"] is True
+    # newest (1.0) is 50% below best same-family prior (2.0): gate at 15% fails
+    ok, message = gate(report, 0.15)
+    assert not ok and "REGRESSION" in message
+    # generous tolerance passes; the CPU-fallback r01 never enters the compare
+    ok, _ = gate(report, 0.6)
+    assert ok

@@ -709,6 +709,26 @@ def _task_setup(n, d, seed=0):
     return X, y, groups, params, task
 
 
+def _final_train_metric(margins, y, task):
+    """(metric name, value) of the trained margins on the bench's own train
+    set — the model-quality stamp next to rounds/sec. Host numpy on the
+    final margins only (one gather after the measured window, never inside
+    it). Ranking would need grouped NDCG; skipped."""
+    m = np.asarray(margins, np.float64)
+    rows = len(y)
+    eps = 1e-7
+    if task in ("binary", "lossguide"):
+        p = np.clip(1.0 / (1.0 + np.exp(-m.reshape(-1)[:rows])), eps, 1 - eps)
+        return "logloss", float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    if task == "multiclass":
+        m = m[:rows]
+        e = np.exp(m - m.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        picked = p[np.arange(rows), y.astype(np.int64)]
+        return "mlogloss", float(-np.mean(np.log(np.clip(picked, eps, None))))
+    return None, None
+
+
 def main():
     # detect a dead accelerator backend up front; an honest, clearly-labeled
     # CPU number is more useful than a 0.0 placeholder
@@ -753,6 +773,10 @@ def main():
     # arm the device window too: the session's compiled-cost introspection
     # (training.compiled) plus the roofline stamp below ride the same gate
     os.environ.setdefault("SM_DEVICE_TELEMETRY", "1")
+    # and the model window: the final JSON stamps a train metric + the last
+    # round's learning stats so BENCH_* snapshots track model quality next
+    # to rounds/sec (a perf win that degrades quality must be visible)
+    os.environ.setdefault("SM_MODEL_TELEMETRY", "1")
     from sagemaker_xgboost_container_tpu.telemetry import register_runtime_gauges
     from sagemaker_xgboost_container_tpu.telemetry.cluster import compile_stats
 
@@ -944,6 +968,24 @@ def main():
     roofline = device_telemetry.maybe_roofline(device_ms, done, source)
     if roofline is not None:
         doc["roofline"] = roofline
+    # model-quality stamp (SM_MODEL_TELEMETRY): the final train metric plus
+    # the last dispatch's on-device learning stats — BENCH_* snapshots carry
+    # quality next to throughput
+    try:
+        metric_name, metric_value = _final_train_metric(session.margins, y, task)
+        model_doc = {}
+        if metric_name is not None:
+            model_doc["train_metric"] = metric_name
+            model_doc["train_value"] = round(metric_value, 6)
+        if session.last_learning_stats:
+            model_doc["learning"] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in session.last_learning_stats[-1].items()
+            }
+        if model_doc:
+            doc["model"] = model_doc
+    except Exception as e:
+        sys.stderr.write("model-quality stamp failed: {}\n".format(e))
     if backend_err is not None:
         doc["backend_init_error"] = backend_err
     print(json.dumps(doc))

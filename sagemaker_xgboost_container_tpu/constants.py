@@ -154,3 +154,4 @@ EXIT_DRAIN_TIMEOUT = 83   # serving drain: in-flight requests still wedged past 
 EXIT_PREDICT_STUCK = 84   # serving watchdog: a predict dispatch wedged past SM_PREDICT_STUCK_S (abort action)
 EXIT_INGEST_FAILED = 85   # streaming ingest: bad-chunk budget exhausted or a cross-rank consistency failure
 EXIT_DEVICE_OOM = 86      # device allocator exhausted (RESOURCE_EXHAUSTED) during a round dispatch; HBM forensics dumped
+EXIT_NUMERIC_POISON = 87  # learning telemetry: NaN/Inf in gradients or margins; learning forensics dumped

@@ -47,6 +47,7 @@ from ..ops.tree_build import (
     unpack_tree,
 )
 from ..toolkit import exceptions as exc
+from ..utils.faults import fault_point
 from . import eval_metrics
 from . import objectives as objectives_mod
 from .forest import Forest, compact_padded_tree
@@ -826,6 +827,20 @@ class _TrainingSession:
         self._device_sync_every = env_int(DEVICE_SYNC_ENV, 0, minimum=0)
         self._dispatch_index = 0
 
+        # model-quality plane (SM_MODEL_TELEMETRY): resolved ONCE here,
+        # host-side, like the hist knobs — unset traces exactly the pre-PR
+        # round program (no stats outputs at all); set adds read-only
+        # reductions of g/h/margins, so committed trees are bit-identical
+        # either way. The drift baseline is one bincount per feature over
+        # the already-binned matrix, captured now and stamped into the
+        # model manifest at save time.
+        from ..telemetry import model as model_telemetry
+
+        self.learning_stats = model_telemetry.enabled()
+        self.last_learning_stats = []
+        if self.learning_stats:
+            model_telemetry.capture_drift_baseline(self.train_binned)
+
         self._round_fn = self._make_round_fn()
         self._apply_fn = self._make_apply_fn()
         self._introspect_compiled_cost()
@@ -927,6 +942,44 @@ class _TrainingSession:
         subsample = cfg.subsample
         num_parallel = cfg.num_parallel_tree
         use_monotone = self.has_monotone
+        collect_stats = self.learning_stats
+
+        def _learning_stats(g, h, margins_new):
+            # read-only reductions of the round's gradients/hessians and
+            # post-update margins; telemetry/model.DEVICE_STAT_FIELDS owns
+            # the layout. Sums/counts psum and extrema pmin/pmax over the
+            # data axis, so the vector is globally exact and replicated
+            # (matching its P() out_spec); nothing here feeds back into the
+            # tree build, keeping committed trees bit-identical.
+            gv = g.reshape(-1)
+            hv = h.reshape(-1)
+            mv = margins_new.reshape(-1)
+            g_fin = jnp.isfinite(gv)
+            h_fin = jnp.isfinite(hv)
+            vec = jnp.stack(
+                [
+                    jnp.sum(jnp.where(g_fin, gv, 0.0)),
+                    jnp.min(jnp.where(g_fin, gv, jnp.inf)),
+                    jnp.max(jnp.where(g_fin, gv, -jnp.inf)),
+                    jnp.sum(jnp.where(h_fin, hv, 0.0)),
+                    jnp.min(jnp.where(h_fin, hv, jnp.inf)),
+                    jnp.max(jnp.where(h_fin, hv, -jnp.inf)),
+                    jnp.sum((~g_fin).astype(jnp.float32)),
+                    jnp.sum((~jnp.isfinite(mv)).astype(jnp.float32)),
+                ]
+            ).astype(jnp.float32)
+            if axis_name is not None:
+                sums = jax.lax.psum(vec, axis_name)
+                mins = jax.lax.pmin(vec, axis_name)
+                maxs = jax.lax.pmax(vec, axis_name)
+                vec = jnp.stack(
+                    [
+                        sums[0], mins[1], maxs[2],
+                        sums[3], mins[4], maxs[5],
+                        sums[6], sums[7],
+                    ]
+                )
+            return vec
 
         def one_round(
             bins, margins, labels, weights, num_cuts, rng, feature_mask, monotone,
@@ -992,7 +1045,9 @@ class _TrainingSession:
                 lambda *leaves: jnp.stack(leaves), *trees
             ) if num_parallel > 1 else trees[0]
             # pack inside the program: the host pulls ONE array per dispatch
-            return pack_tree(stacked), margins
+            if not collect_stats:
+                return pack_tree(stacked), margins
+            return pack_tree(stacked), margins, _learning_stats(g, h, margins)
 
         K = self.rounds_per_dispatch
         colsample = cfg.colsample_bytree
@@ -1036,10 +1091,15 @@ class _TrainingSession:
                         mask = gmask
                 else:
                     mask = feature_mask
-                packed, margins_c = one_round(
+                round_out = one_round(
                     bins, margins_c, labels, weights, num_cuts, rng_j, mask,
                     monotone, rank_index,
                 )
+                if collect_stats:
+                    packed, margins_c, lstats = round_out
+                else:
+                    packed, margins_c = round_out
+                    lstats = None
                 # every non-shared eval set's margins ride the scan carry:
                 # the committed tree applies on device each round whether or
                 # not metrics are device-computable, so the host-fallback
@@ -1096,11 +1156,16 @@ class _TrainingSession:
                     # non-empty dummy: zero-sized scan outputs are a
                     # lowering hazard on some backends
                     scalars = jnp.zeros((1, 1), jnp.float32)
-                return (margins_c, extra), (packed, scalars)
+                outs = (packed, scalars, lstats) if collect_stats else (packed, scalars)
+                return (margins_c, extra), outs
 
-            (margins, eval_m), (packed_all, metrics_all) = jax.lax.scan(
+            (margins, eval_m), outs = jax.lax.scan(
                 body, (margins, eval_m), jnp.arange(K)
             )
+            if collect_stats:
+                packed_all, metrics_all, stats_all = outs
+                return packed_all, metrics_all, margins, eval_m, stats_all
+            packed_all, metrics_all = outs
             return packed_all, metrics_all, margins, eval_m
 
         use_scan = self.use_scan_rounds
@@ -1127,9 +1192,10 @@ class _TrainingSession:
             self.feat_spec,    # monotone
             rank_spec,         # rank_index
         )
+        stats_specs = (P(),) if collect_stats else ()
         if not use_scan:
             in_specs = base_specs
-            out_specs = (P(), margin_spec)
+            out_specs = (P(), margin_spec) + stats_specs
             donate = (1,)
         else:
             eval_specs = tuple(
@@ -1141,7 +1207,7 @@ class _TrainingSession:
                 if b is not None
             )
             in_specs = base_specs + (eval_specs, eval_blw_specs)
-            out_specs = (P(), P(), margin_spec, eval_specs)
+            out_specs = (P(), P(), margin_spec, eval_specs) + stats_specs
             donate = (1, 9)
         mapped = shard_map(
             fn,
@@ -1541,9 +1607,32 @@ class _TrainingSession:
                 self._abort_device_oom(e)
             raise
 
+    def _stash_learning_stats(self, stats_dev):
+        """One small host transfer per dispatch: the per-round learning
+        stats vectors, decoded into dicts the train loop folds (with the
+        committed-tree stats) into ``telemetry/model.note_learning`` and
+        the numeric-health guard. ``[]`` when the plane is unarmed."""
+        if stats_dev is None:
+            self.last_learning_stats = []
+            return
+        from ..telemetry import model as model_telemetry
+
+        rows = np.asarray(stats_dev)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        self.last_learning_stats = [
+            model_telemetry.decode_device_stats(rows[j])
+            for j in range(rows.shape[0])
+        ]
+
     def _run_rounds_inner(self):
         if self.approx_resketch:
             self._resketch_bins()
+        if fault_point("train.gradient_poison", dispatch=self._dispatch_index):
+            # numeric-poison drill: corrupt the live margins so the next
+            # round's gradients genuinely go NaN through the real device
+            # pipeline (the learning-telemetry guard must catch it there)
+            self.margins = self.margins * jnp.float32(np.nan)
         self.rng, sub, colrng = jax.random.split(self.rng, 3)
         d_pad = self.bins.shape[1]
         if self.config.colsample_bytree < 1.0:
@@ -1576,7 +1665,11 @@ class _TrainingSession:
         if not self.use_scan_rounds:
 
             def _dispatch_single():
-                packed, self.margins = self._round_fn(*args)
+                if self.learning_stats:
+                    packed, self.margins, lstats = self._round_fn(*args)
+                else:
+                    packed, self.margins = self._round_fn(*args)
+                    lstats = None
                 for i in range(len(self.eval_sets)):
                     if self.eval_margins[i] is not None:
                         self.eval_margins[i] = self._apply_fn(
@@ -1586,10 +1679,11 @@ class _TrainingSession:
                 # applies are separate jitted programs, and the attribution
                 # fence must cover them too or their device time would leak
                 # into build_eval / the next round's host_dispatch
-                return packed, [m for m in self.eval_margins if m is not None]
+                return packed, lstats, [m for m in self.eval_margins if m is not None]
 
-            packed, _fenced_evals = self._maybe_fenced_dispatch(_dispatch_single)
+            packed, lstats, _fenced_evals = self._maybe_fenced_dispatch(_dispatch_single)
             self._note_comm_dispatch(1)
+            self._stash_learning_stats(lstats)
             return [unpack_tree(np.asarray(packed))], None
         eval_m = tuple(m for m in self.eval_margins if m is not None)
         eval_blw = tuple(
@@ -1597,9 +1691,14 @@ class _TrainingSession:
             for i in range(len(self.eval_bins))
             if self.eval_bins[i] is not None
         )
-        packed, metrics, self.margins, eval_m_out = self._maybe_fenced_dispatch(
+        out = self._maybe_fenced_dispatch(
             lambda: self._round_fn(*args, eval_m, eval_blw)
         )
+        if self.learning_stats:
+            packed, metrics, self.margins, eval_m_out, lstats = out
+        else:
+            packed, metrics, self.margins, eval_m_out = out
+            lstats = None
         ei = 0
         for i in range(len(self.eval_margins)):
             if self.eval_margins[i] is not None:
@@ -1607,6 +1706,7 @@ class _TrainingSession:
                 ei += 1
         packed_np = np.asarray(packed)  # ONE transfer for K rounds
         self._note_comm_dispatch(packed_np.shape[0])
+        self._stash_learning_stats(lstats)
         metrics_np = np.asarray(metrics) if self.device_metric_fns else None
         return (
             [unpack_tree(packed_np[j]) for j in range(packed_np.shape[0])],
@@ -1861,6 +1961,29 @@ def combine_host_metric_entries(results, pairs, finalizers):
     ]
 
 
+def _abort_numeric_poison(round_index):
+    """The numeric-health guard tripped: a NaN/Inf count in the round's
+    learning stats went nonzero. Dump the learning forensics (the last-K
+    stats history, naming the first poisoned round), then take the shared
+    watchdog abort path (checkpoint flush + flight recorder +
+    ``training.abort``) with exit 87 — the stats counters are globally
+    psum'd, so every rank sees the same poisoned round and aborts on it,
+    long before the consensus digest cadence would reach exit 81."""
+    from ..constants import EXIT_NUMERIC_POISON
+    from ..telemetry import model as model_telemetry
+    from ..training import watchdog
+
+    path = model_telemetry.dump_learning_forensics(
+        "numeric_poison", first_bad_round=round_index
+    )
+    watchdog.request_abort(
+        "numeric_poison",
+        EXIT_NUMERIC_POISON,
+        round=int(round_index),
+        forensics=path or "",
+    )
+
+
 def train(
     params,
     dtrain,
@@ -2041,6 +2164,19 @@ def train(
                 break  # trees past the requested count are discarded
             trees, info = _trees_for_round(tree_np)
             forest.append_round(trees, info)
+
+            if j < len(session.last_learning_stats):
+                # model-quality plane: device reductions + committed-tree
+                # stats -> one training.learning record, then the numeric-
+                # health guard (NaN/Inf counters nonzero -> forensics dump
+                # + exit 87 on every rank, naming this round)
+                from ..telemetry import model as model_telemetry
+
+                stats = dict(session.last_learning_stats[j])
+                stats.update(model_telemetry.tree_stats(trees))
+                model_telemetry.note_learning(rnd, stats)
+                if model_telemetry.first_poisoned_round([stats], rnd) is not None:
+                    _abort_numeric_poison(rnd)
 
             if batch_metrics is not None:
                 # device-computed per-round metrics: [K, n_sets, n_metrics]

@@ -111,7 +111,9 @@ class ScoringService:
                 self.model, dtest, get_content_type(content_type)
             )
             feats = serve_utils.canonicalize_features(self.model, dtest)
-            return self._batcher.predict(feats, deadline=deadline)
+            preds = self._batcher.predict(feats, deadline=deadline)
+            serve_utils.observe_drift(feats, preds)
+            return preds
         result = serve_utils.predict(
             self.model, self.model_format, dtest, content_type, objective=self.objective
         )

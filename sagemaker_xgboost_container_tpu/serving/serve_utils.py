@@ -187,7 +187,46 @@ def _load_verified(path):
         raise integrity.IntegrityError(
             "Model artifact {} is structurally invalid: {}".format(path, e)
         )
+    _maybe_arm_drift(manifest)
     return forest, source_format
+
+
+def _maybe_arm_drift(manifest):
+    """Arm the serving drift monitor from the per-feature bin-occupancy
+    baseline the trainer stamped into the model manifest (SM_MODEL_TELEMETRY
+    plane, docs/observability.md §Model window). The window quacks like a
+    breaker: registering it with the lifecycle makes sustained PSI above
+    SM_DRIFT_PSI_MAX surface as DEGRADED in serving_state via the /ping
+    polls, exactly like an SLO burn — visibility, not shedding. Best-effort:
+    an unarmed plane, a baseline-less manifest, or a telemetry failure must
+    never fail a model load."""
+    if not manifest:
+        return
+    try:
+        from ..telemetry import model as model_telemetry
+
+        window = model_telemetry.maybe_install_drift(manifest.get("drift_baseline"))
+        if window is not None:
+            from . import lifecycle
+
+            lifecycle.observe(window)
+    except Exception:
+        logger.debug("drift monitor arm failed", exc_info=True)
+
+
+def observe_drift(features, predictions=None):
+    """Feed one request's (canonicalized) feature matrix and predictions to
+    the drift window. Inert when SM_MODEL_TELEMETRY is off or no baseline
+    traveled with the model; never raises — telemetry must not fail a
+    prediction that already succeeded."""
+    try:
+        from ..telemetry import model as model_telemetry
+
+        window = model_telemetry.active_drift()
+        if window is not None:
+            window.observe(features, predictions)
+    except Exception:
+        logger.debug("drift observe failed", exc_info=True)
 
 
 def get_loaded_booster(model_dir, ensemble=False):
@@ -308,9 +347,13 @@ def predict(model, model_format, dtest, input_content_type, objective=None):
     if isinstance(model, list):
         outs = [_one(b) for b in boosters]
         if objective in (MULTI_SOFTMAX, BINARY_HINGE):
-            return stats.mode(np.stack(outs), axis=0, keepdims=False).mode
-        return np.mean(outs, axis=0)
-    return _one(model)
+            result = stats.mode(np.stack(outs), axis=0, keepdims=False).mode
+        else:
+            result = np.mean(outs, axis=0)
+    else:
+        result = _one(model)
+    observe_drift(canonicalize_features(boosters[0], dtest), result)
+    return result
 
 
 def is_selectable_inference_output():

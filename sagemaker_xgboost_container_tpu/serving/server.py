@@ -234,6 +234,7 @@ def drain_and_shutdown(httpd, lifecycle, reporter=None):
                     lifecycle_mod.GRACEFUL_DRAIN_ENV)
     if reporter is not None:
         reporter.stop(timeout=2.0)
+    telemetry.stop_fleet_plane()
     httpd.shutdown()
     httpd.server_close()
     if lifecycle is not None:
@@ -263,6 +264,11 @@ def serving_entrypoint(port=None, block=True):
     # kill -3 dumps the flight recorder + status snapshot without killing
     # the endpoint (the wedged-predict watchdog owns the abort path)
     telemetry.install_sigquit_handler()
+    # live /status endpoint (SM_STATUS_PORT) on the serving host too — the
+    # drift section (docs/observability.md §Model window) is a serving-side
+    # document; self-gated: no thread or socket unless the knob is set
+    current_host = os.getenv("SM_CURRENT_HOST", "localhost")
+    telemetry.start_fleet_plane([current_host], current_host)
     logger.info(
         "GET /metrics is %s (gate: %s=true)",
         "enabled" if telemetry.metrics_endpoint_enabled() else "disabled",

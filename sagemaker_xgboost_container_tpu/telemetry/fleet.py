@@ -134,6 +134,32 @@ _status_lock = threading.Lock()
 _status = {}
 _started_at = time.monotonic()
 
+# /status document shape version. Bump when sections are added/renamed so
+# dashboards and the fleet smoke drill can detect shape changes instead of
+# KeyError-ing on them. v2 = schema_version itself + the model-telemetry
+# ``learning``/``drift`` sections (SM_MODEL_TELEMETRY).
+STATUS_SCHEMA_VERSION = 2
+
+
+def _model_doc():
+    """The model-telemetry sections shared by ``/status`` and the SIGQUIT
+    dump: ``learning`` (per-round stats + curve summary) and ``drift``
+    (serving PSI window). {} when SM_MODEL_TELEMETRY is unarmed — the
+    sections simply don't render."""
+    doc = {}
+    try:
+        from . import model as model_telemetry
+
+        learning = model_telemetry.learning_status()
+        if learning:
+            doc["learning"] = learning
+        drift = model_telemetry.drift_status()
+        if drift:
+            doc["drift"] = drift
+    except Exception:
+        logger.debug("model telemetry status unavailable", exc_info=True)
+    return doc
+
 
 def note_status(**fields):
     """Merge ``fields`` into the process status dict (None removes a key).
@@ -706,7 +732,10 @@ class StatusServer:
         self._httpd.server_close()
 
     def status_doc(self):
-        doc = {"uptime_s": round(time.monotonic() - _started_at, 1)}
+        doc = {
+            "schema_version": STATUS_SCHEMA_VERSION,
+            "uptime_s": round(time.monotonic() - _started_at, 1),
+        }
         doc.update(status_snapshot())
         snap = ROUND_STATE.snapshot()
         doc["round"] = snap
@@ -731,6 +760,7 @@ class StatusServer:
         memory = _memory_doc(self._collector)
         if memory:
             doc["memory"] = memory
+        doc.update(_model_doc())
         return doc
 
     def profile_doc(self, query):
@@ -959,7 +989,10 @@ def _sigquit_dump(default_dir):
             os.environ.get(tracing.TRACE_EXPORT_DIR_ENV) or default_dir or "."
         )
         # build the same /status view without needing a server instance
-        doc = {"uptime_s": round(time.monotonic() - _started_at, 1)}
+        doc = {
+            "schema_version": STATUS_SCHEMA_VERSION,
+            "uptime_s": round(time.monotonic() - _started_at, 1),
+        }
         doc.update(status_snapshot())
         doc["round"] = ROUND_STATE.snapshot()
         plane = _active_plane
@@ -969,6 +1002,7 @@ def _sigquit_dump(default_dir):
         memory = _memory_doc(plane.collector if plane is not None else None)
         if memory:
             doc["memory"] = memory
+        doc.update(_model_doc())
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(
             directory, "fleet-status-rank{}.json".format(tracing.get_rank())

@@ -777,11 +777,18 @@ def train_job(
                 # full membership log, and one that trained past quarantined
                 # input chunks carries the agreed quarantine record — the
                 # provenance for "this artifact lost those rows".
+                # model telemetry (SM_MODEL_TELEMETRY): the final learning
+                # curve and the drift-PSI baseline ride in the manifest too,
+                # so serving gets the training-time distribution for free
+                from ..telemetry import model as model_telemetry
+
                 integrity.write_manifest(
                     model_location,
                     fingerprint=integrity.config_fingerprint(train_cfg),
                     membership_log=elastic.membership_log() or None,
                     quarantine=streaming.quarantine_record(),
+                    learning=model_telemetry.learning_summary(),
+                    drift_baseline=model_telemetry.drift_baseline(),
                 )
             except OSError as e:
                 logger.warning(

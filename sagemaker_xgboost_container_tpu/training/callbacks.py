@@ -62,21 +62,45 @@ class EvaluationMonitor:
     per K-round dispatch — models/booster.py) rounds between dispatches add
     no fresh entries; printing the stale previous values against a new
     round index would misreport, so those rounds print nothing.
+
+    Under ``SM_MODEL_TELEMETRY`` each printed entry is additionally emitted
+    as a machine-readable ``training.eval`` record and folded into the live
+    learning curve (telemetry/model.py); the stdout line itself is the
+    SageMaker HPO contract and stays byte-identical either way.
     """
 
     def __init__(self):
         self._entries_seen = 0
+        from ..telemetry import model as model_telemetry
+
+        self._model_telemetry = model_telemetry.enabled() and model_telemetry
 
     def after_iteration(self, model, epoch, evals_log):
         parts = []
         total = 0
+        fresh = []
         for data_name, metrics in evals_log.items():
             for metric_name, values in metrics.items():
                 total += len(values)
                 parts.append("{}-{}:{:.5f}".format(data_name, metric_name, values[-1]))
+                fresh.append((data_name, metric_name, values[-1]))
         if parts and total != self._entries_seen:
             self._entries_seen = total
             print("[{}]\t{}".format(epoch, "\t".join(parts)), flush=True)
+            if self._model_telemetry:
+                from ..telemetry import emit_metric
+
+                for data_name, metric_name, value in fresh:
+                    emit_metric(
+                        "training.eval",
+                        round=int(epoch),
+                        dataset=data_name,
+                        name=metric_name,
+                        value=float(value),
+                    )
+                    self._model_telemetry.note_eval(
+                        epoch, data_name, metric_name, value
+                    )
         return False
 
 

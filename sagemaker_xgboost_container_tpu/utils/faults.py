@@ -30,13 +30,20 @@ Spec grammar (entries separated by ``;`` or ``,``)::
                                   (retry->skip->quarantine drills; @2+ with
                                   a small SM_INGEST_MAX_BAD_CHUNKS drills
                                   budget exhaustion -> exit 85)
+    train.gradient_poison:nan@5   the 5th round's margins are poisoned with
+                                  NaN before dispatch (numeric-health drill:
+                                  the learning-telemetry guard must catch it
+                                  and abort with exit 87)
 
 Actions: ``error[:msg]`` -> OSError, ``drop`` -> ConnectionError,
 ``sleep:<seconds>``, ``sigterm`` (os.kill SIGTERM), ``exit:<code>``
 (``os._exit`` — simulated host death, no cleanup), ``kill`` (SIGKILL to
 self — the kill-rank drill helper: unlike ``exit``, not even atexit/flush
 machinery runs, exactly like a preempted or OOM-killed host; arm it on one
-rank's env to kill that specific rank deterministically).
+rank's env to kill that specific rank deterministically), ``nan`` (no
+raise — ``fault_point`` returns truthy and the *call site* poisons its own
+data; used by numeric-poison drills where the corruption must flow through
+the real device pipeline rather than short-circuit it).
 
 **Zero overhead when unarmed**: with ``SM_FAULT_SPEC`` unset the module
 global stays ``None`` and ``fault_point`` is a single attribute read and
@@ -55,7 +62,7 @@ logger = logging.getLogger(__name__)
 
 FAULT_SPEC_ENV = "SM_FAULT_SPEC"
 
-_ACTIONS = ("error", "drop", "sleep", "sigterm", "exit", "kill")
+_ACTIONS = ("error", "drop", "sleep", "sigterm", "exit", "kill", "nan")
 
 # None = inert (the common case); else {point: [_Rule, ...]}
 _ACTIVE = None
@@ -114,6 +121,11 @@ class _Rule:
             # flushes, or socket shutdowns — the honest stand-in for a
             # preempted/OOM-killed host in elastic-membership drills
             os.kill(os.getpid(), signal.SIGKILL)
+        if self.action == "nan":
+            # no raise: the call site owns the poisoning so the bad values
+            # travel the same device path real numeric corruption would
+            return True
+        return None
 
 
 def _parse_entry(entry):
@@ -196,15 +208,23 @@ def fault_counts():
 
 
 def fault_point(name, **ctx):
-    """Declare a named fault point. Inert (one global read) unless armed."""
+    """Declare a named fault point. Inert (one global read) unless armed.
+
+    Returns truthy when a ``nan`` rule fired — the call site then poisons
+    its own data in place; every other action either raises or returns
+    falsy, so existing callers that ignore the return are unaffected.
+    """
     active = _ACTIVE
     if active is None:
-        return
+        return None
     rules = active.get(name)
     if not rules:
-        return
+        return None
+    fired = False
     for rule in rules:
-        rule.fire(ctx)
+        if rule.fire(ctx):
+            fired = True
+    return fired or None
 
 
 configure_from_env()

@@ -153,6 +153,8 @@ def build_manifest(
     size=None,
     membership_log=None,
     quarantine=None,
+    learning=None,
+    drift_baseline=None,
 ):
     """Manifest dict for a model file — THE schema definition; every writer
     (checkpoint sidecars, final-model sidecars) goes through here. ``digest``
@@ -163,7 +165,11 @@ def build_manifest(
     validate ``world_size`` drift against. ``quarantine`` (streaming
     ingest) records the cross-rank-agreed set of input chunks the job
     trained *without* — the provenance record for 'this artifact lost
-    those rows to corrupt input' (data/streaming.quarantine_record)."""
+    those rows to corrupt input' (data/streaming.quarantine_record).
+    ``learning`` (model telemetry, SM_MODEL_TELEMETRY) is the final
+    learning-curve summary; ``drift_baseline`` is the training-time
+    per-feature bin-occupancy histogram the serving drift monitor computes
+    PSI against — both stamped only when the plane was armed."""
     manifest = {
         "manifest_version": MANIFEST_VERSION,
         "sha256": digest if digest is not None else file_digest(model_path),
@@ -177,6 +183,10 @@ def build_manifest(
         manifest["membership_log"] = [dict(t) for t in membership_log]
     if quarantine:
         manifest["quarantine"] = dict(quarantine)
+    if learning:
+        manifest["learning"] = dict(learning)
+    if drift_baseline:
+        manifest["drift_baseline"] = dict(drift_baseline)
     return manifest
 
 
@@ -199,7 +209,7 @@ def dump_manifest_atomic(target_path, manifest, tmp_path):
 
 
 def write_manifest(model_path, iteration=None, fingerprint=None, membership_log=None,
-                   quarantine=None):
+                   quarantine=None, learning=None, drift_baseline=None):
     """Write ``model_path``'s sidecar manifest (tmp + rename, best-effort
     atomic). Used for final model artifacts in ``model_dir`` — serving's
     ``check_model_file`` digest-verifies any artifact whose manifest
@@ -211,6 +221,8 @@ def write_manifest(model_path, iteration=None, fingerprint=None, membership_log=
         fingerprint=fingerprint,
         membership_log=membership_log,
         quarantine=quarantine,
+        learning=learning,
+        drift_baseline=drift_baseline,
     )
     target = manifest_path(model_path)
     # dot-prefixed temp: the serving loader skips dotfiles, so a crash here

@@ -66,6 +66,16 @@ def _bench_point(path, doc):
     roofline = parsed.get("roofline")
     if isinstance(roofline, dict):
         point["roofline_binding"] = roofline.get("binding")
+    # model-quality stamp (SM_MODEL_TELEMETRY): a perf win that degrades
+    # the train metric shows as a bend in THIS curve too
+    model = parsed.get("model")
+    if isinstance(model, dict):
+        if model.get("train_metric") is not None:
+            point["train_metric"] = model["train_metric"]
+            point["train_value"] = model.get("train_value")
+        learning = model.get("learning")
+        if isinstance(learning, dict) and "grad_nonfinite" in learning:
+            point["grad_nonfinite"] = learning["grad_nonfinite"]
     return point
 
 

@@ -118,6 +118,20 @@ if [ $rc -eq 0 ] && [ "$TIER" != "chaos" ]; then
   fi
 fi
 
+# model-telemetry smoke (fast/full): train with SM_MODEL_TELEMETRY=1 and
+# validate the model-quality loop — training.learning/.eval records, the
+# manifest learning + drift_baseline stamps, and the served-drift PSI
+# round-trip (trip + automatic recovery); summary JSON is archived
+# (docs/observability.md §Model window)
+if [ $rc -eq 0 ] && [ "$TIER" != "chaos" ]; then
+  if python "$REPO/scripts/model_smoke.py" "$ARTIFACT_DIR/model"; then
+    echo "model smoke: OK (artifact: $ARTIFACT_DIR/model/model_smoke.json)"
+  else
+    rc=1
+    echo "CI $TIER TIER FAILED (model smoke; see $ARTIFACT_DIR/model)"
+  fi
+fi
+
 # fleet-observability smoke (full): 2-rank loopback run validating the
 # merged trace-fleet.json (pid=rank lanes), the per-round skew fold, and
 # the /status endpoint; the merged trace is archived next to the per-rank

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Model-telemetry smoke: train a tiny model with ``SM_MODEL_TELEMETRY=1``
+and validate the whole model-quality observability loop end to end:
+
+* ``training.learning`` records carry per-round on-device stats (grad/hess
+  reductions, NaN/Inf counters, committed-tree shape),
+* the eval curve folds into a learning summary (best iteration, final
+  metrics),
+* the model manifest is stamped with the learning summary AND the
+  per-feature bin-occupancy drift baseline,
+* a served-drift PSI round-trip: the baseline read back from the manifest
+  arms a DriftWindow; in-distribution traffic stays healthy, shifted
+  traffic trips ``degraded`` + a ``serving.drift`` record, and recovery is
+  automatic once the shifted window ages out.
+
+``scripts/ci.sh`` runs this in the fast tier and archives the summary JSON
+under ``${CI_ARTIFACT_DIR:-.ci-artifacts}/model/``.
+
+Exit codes: 0 OK, 1 any leg of the loop failed.
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SM_MODEL_TELEMETRY"] = "1"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _fail(msg):
+    sys.stderr.write("model smoke FAILED: {}\n".format(msg))
+    return 1
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    out_dir = argv[0] if argv else os.path.join(".ci-artifacts", "model")
+
+    import numpy as np
+
+    from sagemaker_xgboost_container_tpu.data.matrix import DataMatrix
+    from sagemaker_xgboost_container_tpu.models import train
+    from sagemaker_xgboost_container_tpu.telemetry import model as model_telemetry
+    from sagemaker_xgboost_container_tpu.training.callbacks import EvaluationMonitor
+    from sagemaker_xgboost_container_tpu.utils import integrity
+
+    summary = {"smoke": "model", "ok": False}
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 5).astype(np.float32)
+    y = (X[:, 0] + 0.25 * X[:, 1] > 0.6).astype(np.float32)
+    Xv = rng.rand(96, 5).astype(np.float32)
+    yv = (Xv[:, 0] + 0.25 * Xv[:, 1] > 0.6).astype(np.float32)
+
+    # ---- leg 1: training emits structured learning + eval records --------
+    captured = io.StringIO()
+    with redirect_stdout(captured):
+        bst = train(
+            {"objective": "binary:logistic", "max_depth": 3, "max_bin": 32},
+            DataMatrix(X, labels=y),
+            num_boost_round=4,
+            evals=[(DataMatrix(X, labels=y), "train"), (DataMatrix(Xv, labels=yv), "validation")],
+            callbacks=[EvaluationMonitor()],
+        )
+    records = []
+    for line in captured.getvalue().splitlines():
+        if line.startswith("{"):
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                pass
+    learning = [r for r in records if r.get("metric") == "training.learning"]
+    evals_rec = [r for r in records if r.get("metric") == "training.eval"]
+    if not learning:
+        return _fail("no training.learning records on stdout")
+    for field in ("grad_sum", "hess_sum", "grad_nonfinite", "leaves", "max_depth"):
+        if field not in learning[-1]:
+            return _fail("training.learning record lacks {!r}".format(field))
+    if any(r["grad_nonfinite"] != 0 for r in learning):
+        return _fail("clean train reported non-finite gradients")
+    if not evals_rec:
+        return _fail("no training.eval records on stdout")
+    summary["learning_records"] = len(learning)
+    summary["eval_records"] = len(evals_rec)
+
+    curve = model_telemetry.learning_summary()
+    if not curve or "best_iteration" not in curve:
+        return _fail("learning summary missing after an eval'd train")
+    summary["curve"] = curve
+
+    # ---- leg 2: manifest stamp (the algorithm_train save funnel) ---------
+    with tempfile.TemporaryDirectory() as tmp:
+        model_path = os.path.join(tmp, "xgboost-model")
+        bst.save_model(model_path)
+        integrity.write_manifest(
+            model_path,
+            learning=model_telemetry.learning_summary(),
+            drift_baseline=model_telemetry.drift_baseline(),
+        )
+        manifest = integrity.read_manifest(model_path)
+        if not manifest or "drift_baseline" not in manifest:
+            return _fail("manifest lacks the drift_baseline stamp")
+        if "learning" not in manifest:
+            return _fail("manifest lacks the learning-summary stamp")
+        baseline = manifest["drift_baseline"]
+        if len(baseline.get("features", [])) != X.shape[1]:
+            return _fail(
+                "baseline has {} features, expected {}".format(
+                    len(baseline.get("features", [])), X.shape[1]
+                )
+            )
+    summary["baseline_features"] = len(baseline["features"])
+    summary["baseline_rows"] = baseline.get("rows")
+
+    # ---- leg 3: served-drift PSI round-trip ------------------------------
+    clock = [0.0]
+    window = model_telemetry.DriftWindow(
+        baseline,
+        psi_max=0.2,
+        window_s=60.0,
+        min_rows=100,
+        clock=lambda: clock[0],
+    )
+    # in-distribution traffic must never trip the monitor
+    for _ in range(4):
+        batch = rng.rand(32, 5).astype(np.float32)
+        window.observe(batch, predictions=rng.rand(32))
+        clock[0] += 1.0
+    if window.degraded:
+        return _fail("in-distribution traffic tripped the drift monitor")
+    psi_clean = window.snapshot()["psi"]
+    # shifted traffic must drive PSI past the threshold
+    drift_line = io.StringIO()
+    with redirect_stdout(drift_line):
+        for _ in range(4):
+            shifted = (3.0 + rng.rand(32, 5)).astype(np.float32)
+            window.observe(shifted, predictions=rng.rand(32))
+            clock[0] += 1.0
+    if not window.degraded:
+        return _fail("shifted traffic did not trip the drift monitor")
+    psi_drifted = window.snapshot()["psi"]
+    drift_records = [
+        json.loads(line)
+        for line in drift_line.getvalue().splitlines()
+        if line.startswith("{") and '"serving.drift"' in line
+    ]
+    if not any(r.get("drifted") for r in drift_records):
+        return _fail("no serving.drift record on the degraded transition")
+    # recovery is automatic once the shifted batches age out of the window
+    clock[0] += 120.0
+    if window.degraded:
+        return _fail("drift monitor did not recover after the window aged out")
+    summary["psi_clean"] = psi_clean
+    summary["psi_drifted"] = psi_drifted
+    summary["ok"] = True
+
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, "model_smoke.json")
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(
+        "model smoke OK: {} learning records, best_iteration={}, "
+        "PSI {} -> {} (drifted) -> recovered; summary at {}".format(
+            len(learning), curve["best_iteration"], psi_clean, psi_drifted, out_path
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Custom (feval) metrics: the validation matrix against the device-metric
+registry and the hyperparameter schema.
+
+Every metric name the schema advertises (XGB_MAXIMIZE_METRICS +
+XGB_MINIMIZE_METRICS) must be computable by exactly one training channel:
+the sklearn-backed feval (metrics/custom_metrics.py) or the native
+evaluator (models/eval_metrics.py) that the fused dispatch mirrors on
+device (models/device_metrics.py). A name that falls through both would
+validate at submission time and then crash mid-train — the matrix below
+keeps the three registries from drifting apart.
+"""
+
+import numpy as np
+import pytest
+
+from sagemaker_xgboost_container_tpu.algorithm import hyperparameters as hpv
+from sagemaker_xgboost_container_tpu.algorithm import metrics as metrics_mod
+from sagemaker_xgboost_container_tpu.constants import (
+    XGB_MAXIMIZE_METRICS,
+    XGB_MINIMIZE_METRICS,
+)
+from sagemaker_xgboost_container_tpu.metrics import custom_metrics
+from sagemaker_xgboost_container_tpu.models import device_metrics, eval_metrics
+from sagemaker_xgboost_container_tpu.toolkit import exceptions as exc
+
+SCHEMA_METRICS = XGB_MAXIMIZE_METRICS + XGB_MINIMIZE_METRICS
+
+
+@pytest.fixture(scope="module")
+def schema():
+    return hpv.initialize(metrics_mod.initialize())
+
+
+def _objective_for(name):
+    """A representative objective under which ``name`` is legal."""
+    if name in ("auc", "aucpr", "logloss", "error"):
+        return "binary:logistic"
+    if name in ("merror", "mlogloss"):
+        return "multi:softprob"
+    if name in ("map", "ndcg"):
+        return "rank:ndcg"
+    if name == "aft-nloglik" or name == "interval-regression-accuracy":
+        return "survival:aft"
+    if name == "cox-nloglik":
+        return "survival:cox"
+    if name == "poisson-nloglik":
+        return "count:poisson"
+    if name == "gamma-nloglik" or name == "gamma-deviance":
+        return "reg:gamma"
+    if name == "tweedie-nloglik":
+        return "reg:tweedie"
+    return "reg:squarederror"
+
+
+# --------------------------------------------------------------------- matrix
+def test_every_schema_metric_has_a_compute_channel():
+    """No schema-advertised metric may fall through both channels."""
+    orphans = [
+        name
+        for name in SCHEMA_METRICS
+        if name not in custom_metrics.CUSTOM_METRICS
+        and not eval_metrics.is_native_metric(name)
+    ]
+    assert not orphans, "schema metrics with no compute channel: {}".format(orphans)
+
+
+def test_every_schema_metric_validates(schema):
+    """The schema must accept each name it advertises (with an objective
+    the metric is defined for)."""
+    for name in SCHEMA_METRICS:
+        hps = {"num_round": "5", "eval_metric": name, "objective": _objective_for(name)}
+        if hps["objective"].startswith("multi:"):
+            hps["num_class"] = "3"
+        out = schema.validate(hps)
+        assert name in out["eval_metric"], name
+
+
+def test_schema_rejects_unknown_metric(schema):
+    with pytest.raises(exc.UserError):
+        schema.validate({"num_round": "5", "eval_metric": "not_a_metric"})
+
+
+def test_custom_metrics_are_schema_metrics():
+    """Every feval metric must be reachable through the schema — a feval
+    entry the schema rejects is dead code."""
+    missing = sorted(set(custom_metrics.CUSTOM_METRICS) - set(SCHEMA_METRICS))
+    assert not missing, "feval metrics absent from the schema: {}".format(missing)
+
+
+def test_device_coverage_is_a_subset_of_native():
+    """The on-device mirrors may only exist for native metrics: a device
+    kernel for a feval-only metric could never be cross-checked against the
+    host path the fused dispatch falls back to."""
+    for name in SCHEMA_METRICS:
+        fn = device_metrics.make_device_metric(name, _objective_for(name), num_group=3)
+        if fn is not None:
+            assert eval_metrics.is_native_metric(name), name
+
+
+def test_sklearn_only_metrics_force_host_fallback():
+    """``all_supported`` must refuse any list containing a feval metric, so
+    the train loop drops to the once-per-K-rounds host eval cadence instead
+    of silently skipping the metric."""
+    sklearn_only = [
+        n for n in SCHEMA_METRICS
+        if n in custom_metrics.CUSTOM_METRICS and not eval_metrics.is_native_metric(n)
+    ]
+    assert sklearn_only, "expected at least one feval-only metric"
+    for name in sklearn_only:
+        assert (
+            device_metrics.all_supported(["rmse", name], "reg:squarederror", 1) is None
+        ), name
+
+
+# ----------------------------------------------------------------- feval path
+class _FakeDMatrix:
+    def __init__(self, labels):
+        self._labels = np.asarray(labels, dtype=np.float32)
+
+    def get_label(self):
+        return self._labels
+
+
+def test_get_custom_metrics_preserves_order():
+    union = ["auc", "accuracy", "rmse", "f1", "logloss"]
+    assert custom_metrics.get_custom_metrics(union) == ["accuracy", "rmse", "f1"]
+
+
+def test_configure_feval_binary_margins():
+    # margins > 0 <=> predicted positive (xgboost >= 1.2 raw-margin feval)
+    margins = np.array([2.0, -1.0, 0.5, -0.25], dtype=np.float32)
+    dtrain = _FakeDMatrix([1.0, 0.0, 0.0, 0.0])
+    feval = custom_metrics.configure_feval(["accuracy", "precision"])
+    out = dict(feval(margins, dtrain))
+    assert out["accuracy"] == pytest.approx(0.75)
+    assert out["precision"] == pytest.approx(0.5)
+
+
+def test_configure_feval_multiclass_argmax():
+    margins = np.array(
+        [[3.0, 0.0, 0.0], [0.0, 2.0, 0.0], [0.0, 0.0, 1.0], [5.0, 0.0, 0.0]],
+        dtype=np.float32,
+    )
+    dtrain = _FakeDMatrix([0.0, 1.0, 2.0, 1.0])
+    out = dict(custom_metrics.configure_feval(["accuracy"])(margins, dtrain))
+    assert out["accuracy"] == pytest.approx(0.75)
+
+
+def test_f1_binary_rejects_multiclass_labels():
+    margins = np.array([[1.0, 0.0, 0.0]] * 3, dtype=np.float32)
+    dtrain = _FakeDMatrix([0.0, 1.0, 2.0])
+    feval = custom_metrics.configure_feval(["f1_binary"])
+    with pytest.raises(exc.UserError):
+        feval(margins, dtrain)
+
+
+def test_regression_metrics_use_raw_margin():
+    preds = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    dtrain = _FakeDMatrix([1.0, 2.0, 5.0])
+    out = dict(custom_metrics.configure_feval(["mse", "rmse", "mae"])(preds, dtrain))
+    assert out["mse"] == pytest.approx(4.0 / 3.0)
+    assert out["rmse"] == pytest.approx(np.sqrt(4.0 / 3.0))
+    assert out["mae"] == pytest.approx(2.0 / 3.0)
